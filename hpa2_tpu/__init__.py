@@ -1,0 +1,28 @@
+"""hpa2_tpu — a TPU-native directory-MESI DSM simulation framework.
+
+A from-scratch rebuild of the capabilities of ruubhagat/HP-Assignment-2
+(a DASH-style directory-based MESI cache-coherence simulator for a
+distributed shared memory system, /root/reference/assignment.c) designed
+TPU-first:
+
+* ``hpa2_tpu.models``   — the protocol data model and the pure-Python
+  reference-semantics engine (the executable spec / differential oracle).
+* ``hpa2_tpu.ops``      — the JAX execution backend: a single jitted
+  lockstep step function over struct-of-arrays state, vmapped over a
+  batch of independent systems, run to quiescence under
+  ``lax.while_loop``.
+* ``hpa2_tpu.parallel`` — device-mesh sharding (``shard_map``/``pjit``)
+  of the batch and node axes with XLA collectives for cross-shard
+  message delivery.
+* ``hpa2_tpu.utils``    — trace / dump I/O (byte-exact with the
+  reference's ``core_<n>_output.txt`` format), synthetic trace
+  generators, comparison helpers.
+* ``hpa2_tpu.native``   — ctypes bindings to the C++/OpenMP native
+  engine (``native/``), the free-running thread-per-node backend and
+  ops/sec baseline.
+"""
+
+from hpa2_tpu.config import SystemConfig, Semantics
+
+__all__ = ["SystemConfig", "Semantics"]
+__version__ = "0.1.0"

@@ -1,0 +1,19 @@
+"""Protocol data model and the pure-Python reference-semantics engine."""
+
+from hpa2_tpu.models.protocol import (
+    CacheState,
+    DirState,
+    MsgType,
+    Message,
+    Instr,
+    INVALID_ADDR,
+)
+
+__all__ = [
+    "CacheState",
+    "DirState",
+    "MsgType",
+    "Message",
+    "Instr",
+    "INVALID_ADDR",
+]

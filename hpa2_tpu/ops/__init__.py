@@ -1,0 +1,1 @@
+"""JAX execution backend: lockstep SoA step function and run loops."""

@@ -1,0 +1,1 @@
+"""Device-mesh sharding of the batch and node axes (shard_map / pjit)."""

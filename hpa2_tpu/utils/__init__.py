@@ -1,0 +1,16 @@
+"""Trace / dump I/O, synthetic trace generators, comparison helpers."""
+
+from hpa2_tpu.utils.dump import format_processor_state, parse_processor_dump
+from hpa2_tpu.utils.trace import (
+    load_core_trace,
+    load_trace_dir,
+    parse_instruction_order,
+)
+
+__all__ = [
+    "format_processor_state",
+    "parse_processor_dump",
+    "load_core_trace",
+    "load_trace_dir",
+    "parse_instruction_order",
+]

@@ -1,0 +1,29 @@
+"""Test harness config.
+
+All JAX tests run on a virtual 8-device CPU mesh so multi-chip sharding
+is exercised without TPU hardware (the driver separately dry-runs the
+multi-chip path; bench runs on the real chip).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+REFERENCE_TESTS = pathlib.Path("/root/reference/tests")
+
+
+@pytest.fixture(scope="session")
+def reference_tests_dir():
+    if not REFERENCE_TESTS.is_dir():
+        pytest.skip("reference test corpus not available")
+    return REFERENCE_TESTS

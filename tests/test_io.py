@@ -1,0 +1,100 @@
+"""I/O layer tests: trace parsing and byte-exact dump formatting.
+
+The strongest formatter test available without an engine: parse every
+shipped fixture dump back into structured state and re-format it — the
+result must equal the fixture byte for byte (SURVEY.md §7.2 step 1
+gate).
+"""
+
+import glob
+import os
+
+import pytest
+
+from hpa2_tpu.config import SystemConfig
+from hpa2_tpu.models.protocol import CacheState, DirState, Instr
+from hpa2_tpu.utils.dump import format_processor_state, parse_processor_dump
+from hpa2_tpu.utils.trace import (
+    load_core_trace,
+    load_instruction_order,
+    load_trace_dir,
+    parse_core_trace,
+    validate_order_against_traces,
+)
+
+CONFIG = SystemConfig()
+
+
+def all_fixture_dumps(root):
+    pats = [
+        os.path.join(root, "*", "core_*_output.txt"),
+        os.path.join(root, "*", "run_*", "core_*_output.txt"),
+    ]
+    paths = sorted(p for pat in pats for p in glob.glob(str(pat)))
+    assert paths, "no fixture dumps found"
+    return paths
+
+
+def test_fixture_dump_roundtrip_byte_exact(reference_tests_dir):
+    paths = all_fixture_dumps(reference_tests_dir)
+    assert len(paths) >= 36  # 3 single-run suites + 2 + 4 run sets, 4 nodes each
+    for path in paths:
+        with open(path, "r") as f:
+            text = f.read()
+        dump = parse_processor_dump(text)
+        regen = format_processor_state(dump, CONFIG)
+        assert regen == text, f"round-trip mismatch for {path}"
+
+
+def test_parse_sample_trace(reference_tests_dir):
+    instrs = load_core_trace(str(reference_tests_dir / "sample" / "core_0.txt"))
+    assert instrs == [Instr("W", 0x15, 100), Instr("R", 0x17)]
+    empty = load_core_trace(str(reference_tests_dir / "sample" / "core_2.txt"))
+    assert empty == []
+
+
+def test_trace_value_wraps_like_sscanf_hhu():
+    assert parse_core_trace("WR 0x05 300")[0].value == 300 % 256
+
+
+def test_trace_cap_matches_reference():
+    text = "\n".join(f"RD 0x0{i % 10}" for i in range(40))
+    assert len(parse_core_trace(text, max_instr=32)) == 32
+
+
+def test_malformed_trace_rejected():
+    with pytest.raises(ValueError):
+        parse_core_trace("RD 0x05\nBOGUS LINE\n")
+
+
+def test_orders_are_valid_interleavings(reference_tests_dir):
+    suites = {
+        "sample": [str(reference_tests_dir / "sample" / "instruction_order.txt")],
+        "test_1": [str(reference_tests_dir / "test_1" / "instruction_order.txt")],
+        "test_2": [str(reference_tests_dir / "test_2" / "instruction_order.txt")],
+        "test_3": sorted(
+            glob.glob(str(reference_tests_dir / "test_3" / "run_*" / "instruction_order.txt"))
+        ),
+        "test_4": sorted(
+            glob.glob(str(reference_tests_dir / "test_4" / "run_*" / "instruction_order.txt"))
+        ),
+    }
+    for suite, order_paths in suites.items():
+        traces = load_trace_dir(str(reference_tests_dir / suite), CONFIG)
+        assert order_paths, suite
+        for path in order_paths:
+            order = load_instruction_order(path)
+            validate_order_against_traces(order, traces)
+
+
+def test_dump_parser_fields(reference_tests_dir):
+    with open(reference_tests_dir / "sample" / "core_1_output.txt") as f:
+        d = parse_processor_dump(f.read())
+    assert d.proc_id == 1
+    # node 1's memory[5] is address 0x15: P0's write of 100 reached it
+    # via the WRITEBACK_INT/FLUSH intervention when P1 later read 0x15.
+    assert d.memory[5] == 100
+    assert d.dir_state[5] == DirState.S and d.dir_sharers[5] == 0b11
+    assert d.dir_state[7] == DirState.EM and d.dir_sharers[7] == 0b1
+    assert d.cache_addr[1] == 0x15 and d.cache_value[1] == 100
+    assert d.cache_state[1] == CacheState.SHARED
