@@ -1,0 +1,693 @@
+"""The executable spec: a deterministic lockstep directory-MESI engine.
+
+This pure-Python engine defines the protocol semantics that every
+production backend (JAX in ``hpa2_tpu.ops``, C++/OpenMP in ``native/``)
+must match — it is the differential-test oracle (SURVEY.md §7.1).
+
+Semantics are the reference's (assignment.c:187-697) with the
+fixture-semantics deviations of SURVEY.md §6.2 as the default (see
+``hpa2_tpu.config.Semantics``).  Scheduling replaces the reference's
+free-running OpenMP threads (one thread per node, racy, non-terminating
+— assignment.c:135-153) with a deterministic global-cycle lockstep:
+
+  Each cycle:
+    1. *handle*: every node with a non-empty mailbox pops exactly ONE
+       message (FIFO) and runs the protocol handler for it.
+    2. *issue*: every node whose mailbox is now empty and that is not
+       waiting for a reply issues at most one instruction — this is
+       exactly the reference's drain-all-then-issue loop shape
+       (assignment.c:153-699) unrolled one message per cycle.  In
+       *replay* mode only the node matching the next record of a
+       recorded ``instruction_order.txt`` may issue, pinning the
+       interleaving that produced a given fixture set (SURVEY.md §4).
+    3. *deliver*: all messages sent in 1-2 are appended to receiver
+       mailboxes in deterministic order (handle-phase sends first,
+       then issue-phase sends; within a phase by sender id, preserving
+       each sender's emission order).
+    4. *dump*: a node whose trace is exhausted, that is not waiting and
+       whose mailbox is empty (including this cycle's deliveries)
+       snapshots its state once — the reference's
+       dump-at-local-completion semantics (assignment.c:688-697),
+       which still drains in-flight messages first (observed in
+       tests/sample: node 0's dump contains node 1's later
+       EVICT_MODIFIED value).
+
+  Termination = global quiescence: all traces exhausted, nobody
+  waiting, all mailboxes empty (the reference never terminates,
+  assignment.c:153; SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.models.protocol import (
+    CacheState,
+    DirState,
+    Instr,
+    INVALID_ADDR,
+    Message,
+    MsgType,
+    NO_PROC,
+    REPLY_RD_EXCLUSIVE,
+    REPLY_RD_SHARED,
+    bit,
+    count_sharers,
+    find_owner,
+    is_bit_set,
+)
+from hpa2_tpu.utils.dump import NodeDump
+from hpa2_tpu.utils.trace import IssueRecord
+
+
+@dataclasses.dataclass
+class CacheLine:
+    address: int = INVALID_ADDR
+    value: int = 0
+    state: CacheState = CacheState.INVALID
+
+
+@dataclasses.dataclass
+class DirEntry:
+    state: DirState = DirState.U
+    sharers: int = 0
+
+
+class Node:
+    """Private state of one processor node (assignment.c:70-81)."""
+
+    def __init__(self, node_id: int, config: SystemConfig, trace: Sequence[Instr]):
+        self.id = node_id
+        self.config = config
+        # memory init: 20 * id + i, byte-wrapped (assignment.c:779)
+        self.memory: List[int] = [
+            (20 * node_id + i) % 256 for i in range(config.mem_size)
+        ]
+        self.directory: List[DirEntry] = [
+            DirEntry() for _ in range(config.mem_size)
+        ]
+        self.cache: List[CacheLine] = [CacheLine() for _ in range(config.cache_size)]
+        self.trace: List[Instr] = list(trace)
+        self.pc = 0
+        self.waiting = False
+        self.pending_write = 0
+        self.mailbox: Deque[Message] = collections.deque()
+        self.dumped = False
+        self.snapshot: Optional[NodeDump] = None
+        # every legal dump-at-local-completion state: the state at
+        # completion plus the state after each later handled message.
+        # The reference's dump timing is OS-scheduling-dependent (a
+        # thread may be descheduled between finishing its trace and
+        # dumping, so the dump can include effects of arbitrarily many
+        # later messages — fixture evidence: tests/test_3/run_1 core_1
+        # reflects an INV issued 13 records after core_1's last
+        # instruction).  Parity therefore matches fixtures against the
+        # candidate set.
+        self.dump_candidates: List[NodeDump] = []
+
+    # -- helpers ------------------------------------------------------
+
+    def line_for(self, addr: int) -> CacheLine:
+        return self.cache[self.config.cache_index_of(addr)]
+
+    def dump(self) -> NodeDump:
+        return NodeDump(
+            proc_id=self.id,
+            memory=list(self.memory),
+            dir_state=[d.state for d in self.directory],
+            dir_sharers=[d.sharers for d in self.directory],
+            cache_addr=[l.address for l in self.cache],
+            cache_value=[l.value for l in self.cache],
+            cache_state=[l.state for l in self.cache],
+        )
+
+
+class StallError(RuntimeError):
+    """Raised when the engine stops making progress (protocol livelock,
+    or an unachievable replay order)."""
+
+
+class SpecEngine:
+    def __init__(
+        self,
+        config: SystemConfig,
+        traces: Sequence[Sequence[Instr]],
+        replay_order: Optional[Sequence[IssueRecord]] = None,
+        replay_batched: bool = False,
+    ):
+        if len(traces) != config.num_procs:
+            raise ValueError("need one trace per node")
+        self.config = config
+        self.sem: Semantics = config.semantics
+        self.nodes = [Node(i, config, t) for i, t in enumerate(traces)]
+        self.replay_order = list(replay_order) if replay_order is not None else None
+        # "batched" replay lets consecutive order records issue in the
+        # same cycle (one per node) — modeling near-simultaneous issues
+        # whose requests race to a home in sender-id order rather than
+        # strictly in recorded-log order (the DEBUG_INSTR log captures
+        # issue order, not message-arrival order; SURVEY.md §7.4.2).
+        self.replay_batched = replay_batched
+        self.order_pos = 0
+        self.cycle = 0
+        # pending sends for the current cycle: (phase, sender, Message, receiver)
+        self._outbox: List[Tuple[int, int, int, Message]] = []
+        # observability (the reference has none — SURVEY.md §5)
+        self.counters: Dict[str, int] = collections.defaultdict(int)
+        self.max_mailbox_depth = 0
+
+    # -- transport ----------------------------------------------------
+
+    def _send(self, phase: int, receiver: int, msg: Message) -> None:
+        """Buffer a send for end-of-cycle delivery (the lockstep analog
+        of sendMessage's locked enqueue, assignment.c:711-739)."""
+        self.counters[f"msg_{msg.type.name}"] += 1
+        self.counters["msgs_total"] += 1
+        self._outbox.append((phase, msg.sender, receiver, msg))
+
+    def _deliver(self) -> None:
+        # handle-phase sends before issue-phase sends; within a phase,
+        # sender-major, preserving emission order (stable sort).
+        self._outbox.sort(key=lambda t: (t[0], t[1]))
+        for _, _, receiver, msg in self._outbox:
+            box = self.nodes[receiver].mailbox
+            box.append(msg)
+            if len(box) > self.max_mailbox_depth:
+                self.max_mailbox_depth = len(box)
+        self._outbox.clear()
+
+    # -- cache replacement (assignment.c:742-773) ---------------------
+
+    def _replace(self, phase: int, node: Node, line: CacheLine) -> None:
+        if line.state == CacheState.INVALID or line.address == INVALID_ADDR:
+            return
+        home = self.config.home_of(line.address)
+        self.counters["evictions"] += 1
+        if line.state in (CacheState.EXCLUSIVE, CacheState.SHARED):
+            self._send(
+                phase,
+                home,
+                Message(MsgType.EVICT_SHARED, node.id, line.address),
+            )
+        elif line.state == CacheState.MODIFIED:
+            self._send(
+                phase,
+                home,
+                Message(
+                    MsgType.EVICT_MODIFIED, node.id, line.address, value=line.value
+                ),
+            )
+
+    # -- protocol handler (assignment.c:187-566) ----------------------
+
+    def _handle(self, node: Node, msg: Message) -> None:
+        cfg = self.config
+        sem = self.sem
+        home = cfg.home_of(msg.address)
+        blk = cfg.block_of(msg.address)
+        line = node.line_for(msg.address)
+        dir_entry = node.directory[blk] if node.id == home else None
+        t = msg.type
+        PH = 0  # handle phase
+
+        if t == MsgType.READ_REQUEST:
+            assert dir_entry is not None, "READ_REQUEST must arrive at home"
+            reply = Message(
+                MsgType.REPLY_RD, node.id, msg.address,
+                value=node.memory[blk], sharers=REPLY_RD_SHARED,
+            )
+            if dir_entry.state == DirState.U:
+                dir_entry.state = DirState.EM
+                dir_entry.sharers = bit(msg.sender)
+                reply.sharers = REPLY_RD_EXCLUSIVE
+                self._send(PH, msg.sender, reply)
+            elif dir_entry.state == DirState.S:
+                dir_entry.sharers |= bit(msg.sender)
+                reply.sharers = REPLY_RD_SHARED
+                self._send(PH, msg.sender, reply)
+            else:  # EM
+                owner = find_owner(dir_entry.sharers)
+                assert owner != -1
+                if owner == msg.sender:
+                    # owner re-requesting (its copy was evicted-silently
+                    # or lost): serve data, keep EM (assignment.c:215-221)
+                    reply.sharers = REPLY_RD_EXCLUSIVE
+                    self._send(PH, msg.sender, reply)
+                else:
+                    self._send(
+                        PH, owner,
+                        Message(
+                            MsgType.WRITEBACK_INT, node.id, msg.address,
+                            second_receiver=msg.sender,
+                        ),
+                    )
+                    # optimistic pre-flush transition (assignment.c:230-231)
+                    dir_entry.state = DirState.S
+                    dir_entry.sharers |= bit(msg.sender)
+
+        elif t == MsgType.REPLY_RD:
+            if (
+                line.address != INVALID_ADDR
+                and line.address != msg.address
+                and line.state != CacheState.INVALID
+            ):
+                self._replace(PH, node, line)
+            line.address = msg.address
+            line.value = msg.value
+            line.state = (
+                CacheState.EXCLUSIVE
+                if msg.sharers == REPLY_RD_EXCLUSIVE
+                else CacheState.SHARED
+            )
+            node.waiting = False
+
+        elif t == MsgType.WRITEBACK_INT:
+            if line.address == msg.address and line.state in (
+                CacheState.MODIFIED,
+                CacheState.EXCLUSIVE,
+            ):
+                flush = Message(
+                    MsgType.FLUSH, node.id, msg.address,
+                    value=line.value, second_receiver=msg.second_receiver,
+                )
+                self._send(PH, home, flush)
+                if msg.second_receiver != home:
+                    self._send(PH, msg.second_receiver, flush.copy())
+                line.state = CacheState.SHARED
+            elif sem.intervention_miss_policy == "nack":
+                self._send(
+                    PH, home,
+                    Message(
+                        MsgType.NACK, node.id, msg.address,
+                        sharers=0,  # 0 = read intervention
+                        second_receiver=msg.second_receiver,
+                    ),
+                )
+            # else: silent drop (assignment.c:265-270) — requester hangs
+
+        elif t == MsgType.FLUSH:
+            if node.id == home:
+                node.memory[blk] = msg.value
+            if node.id == msg.second_receiver:
+                if (
+                    line.address != INVALID_ADDR
+                    and line.address != msg.address
+                    and line.state != CacheState.INVALID
+                ):
+                    self._replace(PH, node, line)
+                line.address = msg.address
+                line.value = msg.value
+                line.state = CacheState.SHARED
+                node.waiting = False
+
+        elif t == MsgType.UPGRADE:
+            assert dir_entry is not None, "UPGRADE must arrive at home"
+            if dir_entry.state == DirState.S:
+                self._send(
+                    PH, msg.sender,
+                    Message(
+                        MsgType.REPLY_ID, node.id, msg.address,
+                        sharers=dir_entry.sharers & ~bit(msg.sender),
+                    ),
+                )
+                dir_entry.state = DirState.EM
+                dir_entry.sharers = bit(msg.sender)
+            else:
+                # fallback: directory lost track (assignment.c:317-326)
+                dir_entry.state = DirState.EM
+                dir_entry.sharers = bit(msg.sender)
+                self._send(
+                    PH, msg.sender,
+                    Message(MsgType.REPLY_ID, node.id, msg.address, sharers=0),
+                )
+
+        elif t == MsgType.REPLY_ID:
+            fan_out = True
+            if line.address == msg.address and line.state != CacheState.MODIFIED:
+                line.value = node.pending_write
+                line.state = CacheState.MODIFIED
+            elif line.address == msg.address and line.state == CacheState.MODIFIED:
+                pass  # write already applied locally on the S-hit path
+            else:
+                # line was replaced while waiting: drop, no INVs
+                # (assignment.c:339-347)
+                fan_out = False
+            if fan_out:
+                for i in range(self.config.num_procs):
+                    if i != node.id and is_bit_set(msg.sharers, i):
+                        self._send(
+                            PH, i, Message(MsgType.INV, node.id, msg.address)
+                        )
+            node.waiting = False
+
+        elif t == MsgType.INV:
+            if line.address == msg.address and line.state in (
+                CacheState.SHARED,
+                CacheState.EXCLUSIVE,
+            ):
+                line.state = CacheState.INVALID
+                self.counters["invalidations"] += 1
+
+        elif t == MsgType.WRITE_REQUEST:
+            assert dir_entry is not None, "WRITE_REQUEST must arrive at home"
+            if sem.eager_write_request_memory:
+                # HEAD quirk (assignment.c:379); fixtures update memory
+                # only on FLUSH/FLUSH_INVACK/EVICT_MODIFIED
+                node.memory[blk] = msg.value
+            if dir_entry.state == DirState.U:
+                dir_entry.state = DirState.EM
+                dir_entry.sharers = bit(msg.sender)
+                self._send(
+                    PH, msg.sender,
+                    Message(MsgType.REPLY_WR, node.id, msg.address),
+                )
+            elif dir_entry.state == DirState.S:
+                self._send(
+                    PH, msg.sender,
+                    Message(
+                        MsgType.REPLY_ID, node.id, msg.address,
+                        sharers=dir_entry.sharers & ~bit(msg.sender),
+                    ),
+                )
+                dir_entry.state = DirState.EM
+                dir_entry.sharers = bit(msg.sender)
+            else:  # EM
+                owner = find_owner(dir_entry.sharers)
+                assert owner != -1
+                if owner == msg.sender:
+                    self._send(
+                        PH, msg.sender,
+                        Message(MsgType.REPLY_WR, node.id, msg.address),
+                    )
+                else:
+                    self._send(
+                        PH, owner,
+                        Message(
+                            MsgType.WRITEBACK_INV, node.id, msg.address,
+                            second_receiver=msg.sender,
+                        ),
+                    )
+                    # state stays EM; sharers optimistically = requester
+                    # (assignment.c:429)
+                    dir_entry.sharers = bit(msg.sender)
+
+        elif t == MsgType.REPLY_WR:
+            assert (
+                line.address == msg.address
+                or line.address == INVALID_ADDR
+                or line.state == CacheState.INVALID
+            ), "REPLY_WR arrived but the slot holds another valid line"
+            line.address = msg.address
+            line.value = node.pending_write
+            line.state = CacheState.MODIFIED
+            node.waiting = False
+
+        elif t == MsgType.WRITEBACK_INV:
+            if line.address == msg.address and line.state in (
+                CacheState.MODIFIED,
+                CacheState.EXCLUSIVE,
+            ):
+                ack = Message(
+                    MsgType.FLUSH_INVACK, node.id, msg.address,
+                    value=line.value, second_receiver=msg.second_receiver,
+                )
+                self._send(PH, home, ack)
+                if msg.second_receiver != home:
+                    self._send(PH, msg.second_receiver, ack.copy())
+                line.state = CacheState.INVALID
+            elif sem.intervention_miss_policy == "nack":
+                self._send(
+                    PH, home,
+                    Message(
+                        MsgType.NACK, node.id, msg.address,
+                        sharers=1,  # 1 = write intervention
+                        second_receiver=msg.second_receiver,
+                    ),
+                )
+            # else: silent drop (assignment.c:467-472)
+
+        elif t == MsgType.FLUSH_INVACK:
+            if node.id == home:
+                assert dir_entry is not None
+                node.memory[blk] = msg.value
+                dir_entry.state = DirState.EM
+                dir_entry.sharers = bit(msg.second_receiver)
+            if node.id == msg.second_receiver:
+                assert (
+                    line.address == msg.address
+                    or line.address == INVALID_ADDR
+                    or line.state == CacheState.INVALID
+                ), "FLUSH_INVACK arrived but the slot holds another valid line"
+                line.address = msg.address
+                # fixtures: the requester's own pending write survives;
+                # HEAD installs the flushed old value (SURVEY.md §6.2.3)
+                line.value = (
+                    msg.value
+                    if sem.flush_invack_fills_old_value
+                    else node.pending_write
+                )
+                line.state = CacheState.MODIFIED
+                node.waiting = False
+
+        elif t == MsgType.EVICT_SHARED:
+            if node.id == home:
+                assert dir_entry is not None
+                if is_bit_set(dir_entry.sharers, msg.sender):
+                    dir_entry.sharers &= ~bit(msg.sender)
+                    remaining = count_sharers(dir_entry.sharers)
+                    if remaining == 0:
+                        dir_entry.state = DirState.U
+                    elif remaining == 1 and dir_entry.state == DirState.S:
+                        dir_entry.state = DirState.EM
+                        survivor = find_owner(dir_entry.sharers)
+                        notify_type = (
+                            MsgType.EVICT_SHARED
+                            if sem.overloaded_evict_shared_notify
+                            else MsgType.UPGRADE_NOTIFY
+                        )
+                        self._send(
+                            PH, survivor,
+                            Message(notify_type, node.id, msg.address),
+                        )
+            elif sem.overloaded_evict_shared_notify:
+                # HEAD's overloaded upgrade-notify (assignment.c:522-538)
+                if msg.sender == home:
+                    if (
+                        line.address == msg.address
+                        and line.state == CacheState.SHARED
+                    ):
+                        line.state = CacheState.EXCLUSIVE
+            # else: a non-home EVICT_SHARED cannot occur in fixture
+            # semantics (the notify is UPGRADE_NOTIFY)
+
+        elif t == MsgType.UPGRADE_NOTIFY:
+            # home -> surviving sharer: your S copy is now E.  Distinct
+            # type fixes the home-is-a-sharer livelock (SURVEY.md §6.3);
+            # the home itself receives it through its own mailbox too.
+            if msg.sender == home:
+                if line.address == msg.address and line.state == CacheState.SHARED:
+                    line.state = CacheState.EXCLUSIVE
+
+        elif t == MsgType.EVICT_MODIFIED:
+            assert dir_entry is not None, "EVICT_MODIFIED must arrive at home"
+            node.memory[blk] = msg.value
+            if dir_entry.state == DirState.EM and is_bit_set(
+                dir_entry.sharers, msg.sender
+            ):
+                dir_entry.sharers = 0
+                dir_entry.state = DirState.U
+            # else: stale eviction — release-build HEAD leaves the
+            # directory untouched (recovery exists only under DEBUG_MSG,
+            # assignment.c:548-560)
+
+        elif t == MsgType.NACK:
+            # robust mode only: re-serve the original request from
+            # memory.  The stale owner no longer holds the line, so the
+            # home can satisfy the requester directly.
+            assert dir_entry is not None, "NACK must arrive at home"
+            requester = msg.second_receiver
+            if msg.sharers == 0:  # read
+                dir_entry.state = DirState.S
+                dir_entry.sharers |= bit(requester)
+                self._send(
+                    PH, requester,
+                    Message(
+                        MsgType.REPLY_RD, node.id, msg.address,
+                        value=node.memory[blk], sharers=REPLY_RD_SHARED,
+                    ),
+                )
+            else:  # write
+                dir_entry.state = DirState.EM
+                dir_entry.sharers = bit(requester)
+                self._send(
+                    PH, requester,
+                    Message(MsgType.REPLY_WR, node.id, msg.address),
+                )
+
+        else:
+            raise AssertionError(f"unknown message type {t}")
+
+    # -- instruction issue (assignment.c:590-697) ---------------------
+
+    def _issue(self, node: Node) -> None:
+        instr = node.trace[node.pc]
+        node.pc += 1
+        self.counters["instructions"] += 1
+        PH = 1  # issue phase
+        cfg = self.config
+        home = cfg.home_of(instr.address)
+        line = node.line_for(instr.address)
+
+        if instr.op == "R":
+            if line.address == instr.address and line.state != CacheState.INVALID:
+                self.counters["read_hits"] += 1
+            else:
+                self.counters["read_misses"] += 1
+                if line.address != INVALID_ADDR and line.state != CacheState.INVALID:
+                    self._replace(PH, node, line)
+                self._send(
+                    PH, home,
+                    Message(MsgType.READ_REQUEST, node.id, instr.address),
+                )
+                node.waiting = True
+                # placeholder fill (assignment.c:626-628)
+                line.state = CacheState.INVALID
+                line.address = instr.address
+                line.value = 0
+        else:
+            node.pending_write = instr.value
+            if line.address == instr.address and line.state != CacheState.INVALID:
+                self.counters["write_hits"] += 1
+                if line.state in (CacheState.MODIFIED, CacheState.EXCLUSIVE):
+                    line.value = instr.value
+                    line.state = CacheState.MODIFIED  # silent E->M upgrade
+                elif line.state == CacheState.SHARED:
+                    self._send(
+                        PH, home,
+                        Message(MsgType.UPGRADE, node.id, instr.address),
+                    )
+                    # write applied locally before the REPLY_ID arrives
+                    # (assignment.c:656-658)
+                    line.value = instr.value
+                    line.state = CacheState.MODIFIED
+                    node.waiting = True
+            else:
+                self.counters["write_misses"] += 1
+                if line.address != INVALID_ADDR and line.state != CacheState.INVALID:
+                    self._replace(PH, node, line)
+                self._send(
+                    PH, home,
+                    Message(
+                        MsgType.WRITE_REQUEST, node.id, instr.address,
+                        value=instr.value,
+                    ),
+                )
+                node.waiting = True
+                line.state = CacheState.INVALID
+                line.address = instr.address
+                line.value = 0
+
+    # -- the lockstep cycle -------------------------------------------
+
+    def step(self) -> bool:
+        """Run one global cycle.  Returns True if any progress was made."""
+        progress = False
+        handled = [False] * len(self.nodes)
+
+        # 1. handle: one message per node
+        for node in self.nodes:
+            if node.mailbox:
+                msg = node.mailbox.popleft()
+                self._handle(node, msg)
+                handled[node.id] = True
+                progress = True
+
+        # 2. issue
+        if self.replay_order is not None:
+            issued: set = set()
+            while self.order_pos < len(self.replay_order):
+                rec = self.replay_order[self.order_pos]
+                node = self.nodes[rec.proc]
+                ready = (
+                    node.id not in issued
+                    and not node.mailbox
+                    and not node.waiting
+                    and node.pc < len(node.trace)
+                )
+                if not ready:
+                    break
+                nxt = node.trace[node.pc]
+                if (nxt.op, nxt.address) != (rec.op, rec.address):
+                    raise StallError(
+                        f"replay order mismatch at {self.order_pos}: "
+                        f"trace has {nxt}, order has {rec}"
+                    )
+                self._issue(node)
+                issued.add(node.id)
+                self.order_pos += 1
+                progress = True
+                if not self.replay_batched:
+                    break
+        else:
+            for node in self.nodes:
+                if not node.mailbox and not node.waiting and node.pc < len(node.trace):
+                    self._issue(node)
+                    progress = True
+
+        # 3. deliver
+        if self._outbox:
+            self._deliver()
+
+        # 4. dump-at-local-completion snapshots.  The canonical dump is
+        # the *earliest* legal one; every later post-completion state is
+        # kept as a candidate (see Node.dump_candidates).
+        for node in self.nodes:
+            if node.pc >= len(node.trace) and not node.waiting:
+                if not node.dumped:
+                    if not node.mailbox:
+                        node.dumped = True
+                        node.snapshot = node.dump()
+                        node.dump_candidates.append(node.snapshot)
+                        progress = True
+                elif handled[node.id]:
+                    node.dump_candidates.append(node.dump())
+
+        self.cycle += 1
+        return progress
+
+    def quiescent(self) -> bool:
+        return all(
+            n.pc >= len(n.trace) and not n.waiting and not n.mailbox
+            for n in self.nodes
+        ) and (self.replay_order is None or self.order_pos >= len(self.replay_order))
+
+    def run(self, max_cycles: int = 10_000_000) -> None:
+        stall = 0
+        while not (self.quiescent() and all(n.dumped for n in self.nodes)):
+            progress = self.step()
+            if self.cycle >= max_cycles:
+                raise StallError(f"no quiescence after {max_cycles} cycles")
+            if not progress:
+                stall += 1
+                if stall > 2:
+                    waiting = [n.id for n in self.nodes if n.waiting]
+                    raise StallError(
+                        f"livelock at cycle {self.cycle}: nodes {waiting} wait "
+                        "forever (stale intervention dropped? use "
+                        "Semantics.intervention_miss_policy='nack')"
+                    )
+            else:
+                stall = 0
+
+    # -- results ------------------------------------------------------
+
+    def snapshots(self) -> List[NodeDump]:
+        return [
+            n.snapshot if n.snapshot is not None else n.dump() for n in self.nodes
+        ]
+
+    def final_dumps(self) -> List[NodeDump]:
+        """Final quiescent state (a mode the reference lacks)."""
+        return [n.dump() for n in self.nodes]

@@ -74,7 +74,7 @@ def format_processor_state(dump: NodeDump, config: SystemConfig) -> str:
     out.append("| Index | Address |   Value  |\n")
     out.append("|----------------------------|\n")
     for i in range(config.mem_size):
-        addr = (pid << 4) + i
+        addr = config.make_addr(pid, i)
         out.append(f"|  {i:3d}  |  0x{addr:02X}   |  {dump.memory[i]:5d}   |\n")
     out.append("------------------------------\n\n")
 
@@ -84,7 +84,7 @@ def format_processor_state(dump: NodeDump, config: SystemConfig) -> str:
     out.append("| Index | Address | State |    BitVector   |\n")
     out.append("|------------------------------------------|\n")
     for i in range(config.mem_size):
-        addr = (pid << 4) + i
+        addr = config.make_addr(pid, i)
         state = _DIR_STATE_STR[int(dump.dir_state[i])]
         vec = _render_sharers(dump.dir_sharers[i])
         out.append(f"|  {i:3d}  |  0x{addr:02X}   |  {state:>2s}   |   0x{vec}   |\n")
@@ -201,6 +201,11 @@ def parse_processor_dump(text: str) -> NodeDump:
 
     if proc_id is None or not memory or not dir_state or not cache_addr:
         raise ValueError("not a recognizable processor dump")
+    if len(memory) != len(dir_state):
+        raise ValueError(
+            f"malformed dump: {len(memory)} memory rows but "
+            f"{len(dir_state)} directory rows (a row failed to parse?)"
+        )
     return NodeDump(
         proc_id=proc_id,
         memory=memory,

@@ -5,11 +5,13 @@ File formats are the reference's (README.md:55-68):
 * ``tests/<dir>/core_<n>.txt`` — one instruction per line,
   ``RD <hexaddr>`` or ``WR <hexaddr> <decvalue>``.  The reference
   parses with ``sscanf("RD %hhx")`` / ``("WR %hhx %hhu")`` and caps at
-  ``MAX_INSTR_NUM`` lines (assignment.c:802-818).  Its parser also
-  counts *malformed* lines, leaving uninitialized instruction slots
-  (SURVEY.md §2.3 "dead/vestigial") — this loader instead rejects
-  malformed non-blank lines and skips blanks, which is behaviorally
-  identical on every well-formed trace.
+  ``MAX_INSTR_NUM`` lines (assignment.c:802-818).  Deliberate loader
+  deviations (all fail-fast where the reference corrupts silently):
+  malformed non-blank lines raise instead of leaving uninitialized
+  instruction slots; blank lines are skipped instead of counted; and
+  addresses out of range for the config raise in ``load_trace_dir``
+  instead of wrapping like ``%hhx`` (the reference would truncate
+  ``0x115`` to ``0x15``).  Write values wrap mod 256 like ``%hhu``.
 * ``instruction_order.txt`` — the recorded issue interleaving, i.e. the
   reference's DEBUG_INSTR stdout lines
   ``Processor %d: instr type=%c, address=0x%02X, value=%d``
@@ -23,8 +25,6 @@ import dataclasses
 import os
 import re
 from typing import List, Optional, Sequence
-
-import numpy as np
 
 from hpa2_tpu.config import SystemConfig
 from hpa2_tpu.models.protocol import Instr
@@ -76,7 +76,15 @@ def load_trace_dir(
     traces = []
     for n in range(config.num_procs):
         path = os.path.join(trace_dir, f"core_{n}.txt")
-        traces.append(load_core_trace(path, cap))
+        trace = load_core_trace(path, cap)
+        for i, instr in enumerate(trace):
+            if not (0 <= instr.address < config.num_addresses):
+                raise ValueError(
+                    f"{path} instr {i}: address 0x{instr.address:X} out of "
+                    f"range for {config.num_procs} nodes x "
+                    f"{config.mem_size} blocks"
+                )
+        traces.append(trace)
     return traces
 
 
@@ -121,6 +129,11 @@ def validate_order_against_traces(
     """Check a recorded order is exactly an interleaving of the traces."""
     cursors = [0] * len(traces)
     for i, rec in enumerate(order):
+        if not (0 <= rec.proc < len(traces)):
+            raise ValueError(
+                f"order line {i}: processor id {rec.proc} out of range "
+                f"(have {len(traces)} traces)"
+            )
         tr = traces[rec.proc]
         if cursors[rec.proc] >= len(tr):
             raise ValueError(f"order line {i}: proc {rec.proc} trace exhausted")
@@ -149,6 +162,8 @@ def gen_uniform_random(
 ) -> List[List[Instr]]:
     """Uniform-random RD/WR over the whole address space — the
     high-sharing / INV-storm workload (BASELINE.json config 3)."""
+    import numpy as np
+
     rng = np.random.default_rng(seed)
     traces = []
     for n in range(config.num_procs):
@@ -171,6 +186,8 @@ def gen_producer_consumer(
 ) -> List[List[Instr]]:
     """Neighbor producer/consumer sharing pattern (BASELINE.json
     config 4): node n writes its own blocks, reads node (n+1)'s."""
+    import numpy as np
+
     rng = np.random.default_rng(seed)
     traces = []
     for n in range(config.num_procs):
@@ -195,6 +212,8 @@ def gen_local_only(
     write_frac: float = 0.5,
 ) -> List[List[Instr]]:
     """Node-local traffic only (the deterministic test_1/test_2 shape)."""
+    import numpy as np
+
     rng = np.random.default_rng(seed)
     traces = []
     for n in range(config.num_procs):

@@ -1,0 +1,131 @@
+"""Golden fixture parity for the Python spec engine (SURVEY.md §7.2 gate 2).
+
+* sample / test_1 / test_2 — deterministic suites: the engine's
+  canonical (earliest) dump-at-local-completion snapshot must equal the
+  fixture byte for byte.
+* test_3 (2 run sets) / test_4 (4 run sets) — nondeterministic suites:
+  replayed from each run set's recorded ``instruction_order.txt``.  The
+  reference's dump moment is OS-scheduling dependent (a thread can be
+  descheduled between finishing its trace and dumping), so a node
+  matches if ANY of its legal dump-timing candidates reproduces the
+  fixture byte-exactly.
+
+KNOWN ANOMALY — test_4/run_1/core_2: the fixture shows block 0x20 as
+``dir U`` with memory 40 and a cache line INVALID/40.  Exhaustive
+reachability analysis over the reference protocol (all message-arrival
+interleavings, all issue interleavings consistent with per-node program
+order, all dump points — see test_fixture_anomaly.py) proves the only
+reachable INVALID/40 dump states have ``dir EM{3}`` or ``S{1,3}``:
+the fixture's directory row is unreachable and therefore cannot have
+been produced by the same execution as the paired instruction_order.txt
+(nor by any execution of the shipped protocol).  The parity gate pins
+this node to "matches a candidate except exactly that one directory
+row" so any further drift still fails loudly.
+"""
+
+import os
+
+import pytest
+
+from hpa2_tpu.config import SystemConfig
+from hpa2_tpu.models.protocol import DirState
+from hpa2_tpu.utils.dump import format_processor_state, parse_processor_dump
+from hpa2_tpu.utils.parity import (
+    check_suite,
+    diff_against_fixtures,
+    discover_run_sets,
+    replay_run_set,
+)
+
+CONFIG = SystemConfig()
+
+DETERMINISTIC_SUITES = ["sample", "test_1", "test_2"]
+REPLAY_SUITES = ["test_3", "test_4"]
+
+ANOMALY_RUN = "test_4/run_1"
+ANOMALY_NODE = 2
+ANOMALY_BLOCK = 0  # block index of address 0x20 at its home node 2
+
+
+@pytest.mark.parametrize("suite", DETERMINISTIC_SUITES)
+def test_deterministic_suite_byte_exact(reference_tests_dir, suite):
+    suite_dir = str(reference_tests_dir / suite)
+    # strict: canonical earliest snapshot only, no candidate slack
+    results = check_suite(suite_dir, CONFIG, allow_candidates=False)
+    for run_dir, diffs in results.items():
+        assert not diffs, f"{run_dir}:\n" + "\n".join(diffs.values())
+
+
+@pytest.mark.parametrize("suite", REPLAY_SUITES)
+def test_replay_suite_candidate_exact(reference_tests_dir, suite):
+    suite_dir = str(reference_tests_dir / suite)
+    for run_dir in discover_run_sets(suite_dir):
+        engine = replay_run_set(suite_dir, run_dir, CONFIG)
+        diffs = diff_against_fixtures(engine, run_dir, CONFIG)
+        rel = os.path.relpath(run_dir, str(reference_tests_dir))
+        if rel == ANOMALY_RUN:
+            assert set(diffs) <= {ANOMALY_NODE}, (
+                f"{rel}: unexpected mismatches beyond the documented "
+                f"anomaly:\n" + "\n".join(diffs.values())
+            )
+            _check_anomaly_envelope(engine, run_dir)
+        else:
+            assert not diffs, f"{rel}:\n" + "\n".join(diffs.values())
+
+
+def _check_anomaly_envelope(engine, run_dir):
+    """The anomalous fixture must differ from some legal candidate in
+    exactly the one proven-unreachable directory row (block 0x20:
+    fixture U/{} vs engine EM/{3})."""
+    node = engine.nodes[ANOMALY_NODE]
+    with open(os.path.join(run_dir, f"core_{ANOMALY_NODE}_output.txt")) as f:
+        fixture = parse_processor_dump(f.read())
+    for cand in node.dump_candidates:
+        same = (
+            cand.memory == fixture.memory
+            and cand.cache_addr == fixture.cache_addr
+            and cand.cache_value == fixture.cache_value
+            and cand.cache_state == fixture.cache_state
+        )
+        dirs_same_elsewhere = all(
+            (cand.dir_state[i], cand.dir_sharers[i])
+            == (fixture.dir_state[i], fixture.dir_sharers[i])
+            for i in range(CONFIG.mem_size)
+            if i != ANOMALY_BLOCK
+        )
+        if same and dirs_same_elsewhere:
+            assert fixture.dir_state[ANOMALY_BLOCK] == DirState.U
+            assert fixture.dir_sharers[ANOMALY_BLOCK] == 0
+            assert cand.dir_state[ANOMALY_BLOCK] == DirState.EM
+            assert cand.dir_sharers[ANOMALY_BLOCK] == 0b1000  # owner {3}
+            return
+    pytest.fail(
+        "no candidate matches the anomalous fixture modulo the documented "
+        "directory row — engine behavior drifted"
+    )
+
+
+def test_engine_reports_counters(reference_tests_dir):
+    suite_dir = str(reference_tests_dir / "test_1")
+    engine = replay_run_set(suite_dir, suite_dir, CONFIG)
+    c = engine.counters
+    assert c["instructions"] == 68  # 17 instrs x 4 cores
+    assert c["msgs_total"] > 0
+    assert engine.max_mailbox_depth <= CONFIG.msg_buffer_size
+
+
+def test_free_run_matches_fixtures_on_deterministic_suites(reference_tests_dir):
+    """Without a replay order (free-running lockstep), node-local-only
+    suites must still reproduce fixtures: scheduling cannot matter."""
+    from hpa2_tpu.models.spec_engine import SpecEngine
+    from hpa2_tpu.utils.trace import load_trace_dir
+
+    for suite in ["test_1", "test_2"]:
+        suite_dir = str(reference_tests_dir / suite)
+        traces = load_trace_dir(suite_dir, CONFIG)
+        engine = SpecEngine(CONFIG, traces)
+        engine.run()
+        for node in engine.nodes:
+            with open(os.path.join(suite_dir, f"core_{node.id}_output.txt")) as f:
+                expected = f.read()
+            assert format_processor_state(node.snapshot, CONFIG) == expected
