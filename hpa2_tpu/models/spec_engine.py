@@ -152,7 +152,7 @@ class SpecEngine:
         self.replay_batched = replay_batched
         self.order_pos = 0
         self.cycle = 0
-        # pending sends for the current cycle: (phase, sender, Message, receiver)
+        # pending sends for the current cycle: (phase, sender, receiver, Message)
         self._outbox: List[Tuple[int, int, int, Message]] = []
         # observability (the reference has none — SURVEY.md §5)
         self.counters: Dict[str, int] = collections.defaultdict(int)

@@ -1,0 +1,88 @@
+"""Multi-word sharer-bitmask primitives (vectorized, fixed shape).
+
+The reference caps node count at 8 via a 1-byte ``bitVector``
+(assignment.c:49, README.md:51).  Here sharer sets are ``[..., W]``
+arrays of uint32 words (W = ceil(num_procs/32)), so node count is an
+array dimension — the "long-context" scaling axis of this framework
+(SURVEY.md §5).  All ops are branch-free and shape-stable for XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+WORD_BITS = 32
+_U32 = jnp.uint32
+
+
+def zero_mask(shape_prefix, words: int):
+    return jnp.zeros((*shape_prefix, words), dtype=_U32)
+
+
+def bit_mask(proc, words: int):
+    """One-hot sharer mask for node id(s) ``proc`` (int array [...])
+    -> [..., W].  Negative ids produce an all-zero mask."""
+    proc = jnp.asarray(proc)
+    word_idx = jnp.arange(words, dtype=jnp.int32)
+    target = proc[..., None] // WORD_BITS
+    shift = (proc[..., None] % WORD_BITS).astype(_U32)
+    valid = (proc[..., None] >= 0) & (word_idx == target)
+    return jnp.where(valid, _U32(1) << shift, _U32(0))
+
+
+def test_bit(mask, proc):
+    """mask [..., W], proc int [...] -> bool [...]."""
+    return jnp.any(mask & bit_mask(proc, mask.shape[-1]) != 0, axis=-1)
+
+
+def set_bit(mask, proc):
+    return mask | bit_mask(proc, mask.shape[-1])
+
+
+def clear_bit(mask, proc):
+    return mask & ~bit_mask(proc, mask.shape[-1])
+
+
+def popcount(mask):
+    """mask [..., W] -> int32 [...]: number of sharers."""
+    return jnp.sum(
+        jax.lax.population_count(mask).astype(jnp.int32), axis=-1
+    )
+
+
+def find_owner(mask):
+    """Lowest set bit index [..., W] -> int32 [...] (-1 if empty).
+
+    Matches the reference's findOwner (assignment.c:98-105).
+    """
+    lsb = mask & (~mask + _U32(1))  # isolate lowest set bit per word
+    ctz = jax.lax.population_count(lsb - _U32(1)).astype(jnp.int32)
+    word_idx = jnp.arange(mask.shape[-1], dtype=jnp.int32)
+    big = jnp.int32(1 << 30)
+    cand = jnp.where(mask != 0, word_idx * WORD_BITS + ctz, big)
+    low = jnp.min(cand, axis=-1)
+    return jnp.where(low >= big, jnp.int32(-1), low)
+
+
+def is_empty(mask):
+    return jnp.all(mask == 0, axis=-1)
+
+
+def from_int(value: int, words: int):
+    """Python int bitmask -> [W] uint32 array (host-side init)."""
+    return jnp.array(
+        [(value >> (WORD_BITS * w)) & 0xFFFFFFFF for w in range(words)],
+        dtype=_U32,
+    )
+
+
+def to_int(mask) -> int:
+    """[W] uint32 array -> Python int (host-side readback)."""
+    import numpy as np
+
+    arr = np.asarray(mask, dtype=np.uint64)
+    out = 0
+    for w in range(arr.shape[-1]):
+        out |= int(arr[w]) << (WORD_BITS * w)
+    return out

@@ -1,0 +1,252 @@
+"""Host-side driver for the JAX backend.
+
+``JaxEngine`` mirrors the spec engine's interface closely enough for
+the parity/differential harnesses: build state from traces, run to
+quiescence (fully on device via ``lax.while_loop``), read back
+dump-at-local-completion snapshots and final state as ``NodeDump``s.
+
+``run_capturing_candidates`` runs the same jitted step cycle-by-cycle
+from the host, recording every legal dump-timing state per node
+(matching ``spec_engine.Node.dump_candidates``) — used by fixture
+parity tests; the all-on-device path is the production/benchmark one.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+import jax.numpy as jnp
+
+from hpa2_tpu.config import SystemConfig
+from hpa2_tpu.models.protocol import Instr
+from hpa2_tpu.models.spec_engine import StallError
+from hpa2_tpu.ops import bits
+from hpa2_tpu.ops.state import SimState, init_state
+from hpa2_tpu.ops.step import (
+    build_run,
+    build_step,
+    build_step_jitted,
+    quiescent,
+)
+from hpa2_tpu.utils.dump import NodeDump
+from hpa2_tpu.utils.trace import IssueRecord
+
+
+def _node_dump_from(arrs, node_id: int) -> NodeDump:
+    mem, dstate, dsh, caddr, cval, cstate = arrs
+    return NodeDump(
+        proc_id=node_id,
+        memory=[int(x) for x in mem[node_id]],
+        dir_state=[int(x) for x in dstate[node_id]],
+        dir_sharers=[bits.to_int(m) for m in dsh[node_id]],
+        cache_addr=[int(x) for x in caddr[node_id]],
+        cache_value=[int(x) for x in cval[node_id]],
+        cache_state=[int(x) for x in cstate[node_id]],
+    )
+
+
+class JaxEngine:
+    def __init__(
+        self,
+        config: SystemConfig,
+        traces: Sequence[Sequence[Instr]],
+        replay_order: Optional[Sequence[IssueRecord]] = None,
+        max_cycles: int = 1_000_000,
+    ):
+        self.config = config
+        self.max_cycles = max_cycles
+        self.replay = replay_order is not None
+        if self.replay:
+            # fail fast like the spec engine instead of simulating a
+            # wrong-but-plausible run from a mismatched order log
+            from hpa2_tpu.utils.trace import validate_order_against_traces
+
+            validate_order_against_traces(replay_order, traces)
+        self.state: SimState = init_state(config, traces, replay_order)
+        self._run = build_run(config, replay=self.replay, max_cycles=max_cycles)
+        self.dump_candidates: List[List[NodeDump]] = [
+            [] for _ in range(config.num_procs)
+        ]
+
+    # -- production path: whole run on device -------------------------
+
+    def run(self) -> "JaxEngine":
+        st = self._run(self.state)
+        st = jax.tree_util.tree_map(lambda x: x.block_until_ready(), st)
+        self.state = st
+        self._check_completed(st)
+        return self
+
+    def _check_completed(self, st: SimState) -> None:
+        if bool(st.overflow):
+            raise StallError(
+                "mailbox capacity exceeded; raise msg_buffer_size"
+            )
+        if not bool(quiescent(st)):
+            raise StallError(
+                f"no quiescence after {int(st.cycle)} cycles "
+                "(livelock: stale intervention dropped? use "
+                "Semantics.intervention_miss_policy='nack')"
+            )
+
+    # -- parity path: per-cycle stepping with candidate capture -------
+
+    def run_capturing_candidates(self) -> "JaxEngine":
+        step = build_step_jitted(self.config, replay=self.replay)
+        st = self.state
+        n = self.config.num_procs
+        completed = np.zeros(n, dtype=bool)
+        cycles = 0
+        while not bool(quiescent(st)):
+            if cycles >= self.max_cycles or bool(st.overflow):
+                self.state = st
+                self._check_completed(st)
+                break
+            handled = np.asarray(st.mb_count) > 0
+            st = step(st)
+            cycles += 1
+            snap_taken = np.asarray(st.snap_taken)
+            capture = [
+                i
+                for i in range(n)
+                if (snap_taken[i] and not completed[i])
+                or (completed[i] and handled[i])
+            ]
+            if capture:
+                arrs = self._live_arrays(st)
+                for i in capture:
+                    if not completed[i]:
+                        completed[i] = True
+                    self.dump_candidates[i].append(_node_dump_from(arrs, i))
+        self.state = st
+        return self
+
+    # -- readback -----------------------------------------------------
+
+    @staticmethod
+    def _live_arrays(st: SimState):
+        return tuple(
+            np.asarray(x)
+            for x in (
+                st.mem, st.dir_state, st.dir_sharers,
+                st.cache_addr, st.cache_val, st.cache_state,
+            )
+        )
+
+    @staticmethod
+    def _snap_arrays(st: SimState):
+        return tuple(
+            np.asarray(x)
+            for x in (
+                st.snap_mem, st.snap_dir_state, st.snap_dir_sharers,
+                st.snap_cache_addr, st.snap_cache_val, st.snap_cache_state,
+            )
+        )
+
+    def snapshots(self) -> List[NodeDump]:
+        """Canonical (earliest) dump-at-local-completion per node."""
+        arrs = self._snap_arrays(self.state)
+        return [
+            _node_dump_from(arrs, i) for i in range(self.config.num_procs)
+        ]
+
+    def final_dumps(self) -> List[NodeDump]:
+        arrs = self._live_arrays(self.state)
+        return [
+            _node_dump_from(arrs, i) for i in range(self.config.num_procs)
+        ]
+
+    @property
+    def cycle(self) -> int:
+        return int(self.state.cycle)
+
+    @property
+    def instructions(self) -> int:
+        return int(self.state.n_instr)
+
+    @property
+    def messages(self) -> int:
+        return int(self.state.n_msgs)
+
+
+# ---------------------------------------------------------------------------
+# Batched ensembles: B independent systems advanced by one vmapped step
+# (the data-parallel axis — BASELINE.json config 5)
+# ---------------------------------------------------------------------------
+
+def stack_states(states: Sequence[SimState]) -> SimState:
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=16)
+def build_batched_run(config: SystemConfig, max_cycles: int = 1_000_000):
+    """Jitted run-to-quiescence for a batch of systems.
+
+    One ``lax.while_loop`` drives a vmapped step until EVERY system in
+    the batch is quiescent; already-quiescent systems no-op (their
+    mailboxes are empty and traces exhausted, so the step leaves them
+    unchanged apart from the cycle counter).
+    """
+    step = build_step(config, replay=False)
+    vstep = jax.vmap(step)
+    vquiet = jax.vmap(quiescent)
+
+    def cond(st):
+        return (
+            jnp.any(~vquiet(st))
+            & jnp.all(st.cycle < max_cycles)
+            & ~jnp.any(st.overflow)
+        )
+
+    def run(st: SimState) -> SimState:
+        return jax.lax.while_loop(cond, vstep, st)
+
+    return jax.jit(run)
+
+
+class BatchJaxEngine:
+    """An ensemble of B independent systems on one chip (vmap over the
+    batch axis)."""
+
+    def __init__(
+        self,
+        config: SystemConfig,
+        batch_traces: Sequence[Sequence[Sequence[Instr]]],
+        max_cycles: int = 1_000_000,
+    ):
+        self.config = config
+        max_t = max(
+            (len(tr) for traces in batch_traces for tr in traces), default=1
+        )
+        self.state = stack_states(
+            [init_state(config, t, max_trace_len=max_t) for t in batch_traces]
+        )
+        self._run = build_batched_run(config, max_cycles=max_cycles)
+
+    def run(self) -> "BatchJaxEngine":
+        st = self._run(self.state)
+        st = jax.tree_util.tree_map(lambda x: x.block_until_ready(), st)
+        self.state = st
+        if bool(jnp.any(st.overflow)):
+            raise StallError("mailbox capacity exceeded in batch")
+        if not bool(jnp.all(jax.vmap(quiescent)(st))):
+            raise StallError("batch did not reach quiescence (livelock?)")
+        return self
+
+    def system_snapshots(self, b: int) -> List[NodeDump]:
+        st_b = jax.tree_util.tree_map(lambda x: x[b], self.state)
+        arrs = JaxEngine._snap_arrays(st_b)
+        return [
+            _node_dump_from(arrs, i) for i in range(self.config.num_procs)
+        ]
+
+    @property
+    def instructions(self) -> int:
+        return int(jnp.sum(self.state.n_instr))

@@ -1,0 +1,158 @@
+"""Struct-of-arrays simulator state (the JAX backend's data model).
+
+The reference keeps each node's state in a per-thread C struct
+(assignment.c:70-81) and communicates through locked ring-buffer
+mailboxes (assignment.c:63-68, 90-91).  The TPU-native layout turns
+every field into an array over the node axis (and, via vmap, a batch
+axis), and the mailboxes into fixed-capacity ring buffers
+``[nodes, cap]`` updated by masked scatters inside one jitted step —
+no locks: lockstep scheduling makes delivery deterministic
+(SURVEY.md §2.4, §5).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from hpa2_tpu.config import SystemConfig
+from hpa2_tpu.models.protocol import Instr, INVALID_ADDR, CacheState, DirState
+from hpa2_tpu.utils.trace import IssueRecord
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+class SimState(NamedTuple):
+    """One simulated system (no batch axis; vmap adds it)."""
+
+    # caches [N, C]
+    cache_addr: jnp.ndarray
+    cache_val: jnp.ndarray
+    cache_state: jnp.ndarray
+    # home memory + directory [N, M] (+ [W] sharer words)
+    mem: jnp.ndarray
+    dir_state: jnp.ndarray
+    dir_sharers: jnp.ndarray  # [N, M, W] uint32
+    # mailboxes [N, cap]
+    mb_type: jnp.ndarray
+    mb_sender: jnp.ndarray
+    mb_addr: jnp.ndarray
+    mb_value: jnp.ndarray
+    mb_sharers: jnp.ndarray  # [N, cap, W] uint32
+    mb_second: jnp.ndarray
+    mb_head: jnp.ndarray  # [N]
+    mb_count: jnp.ndarray  # [N]
+    # core state [N]
+    pc: jnp.ndarray
+    waiting: jnp.ndarray  # bool
+    pending_write: jnp.ndarray
+    # traces [N, T]
+    tr_op: jnp.ndarray  # 0 = RD, 1 = WR
+    tr_addr: jnp.ndarray
+    tr_val: jnp.ndarray
+    tr_len: jnp.ndarray  # [N]
+    # replay schedule [L] (L=1 dummy when not replaying)
+    order_node: jnp.ndarray
+    order_pos: jnp.ndarray  # scalar
+    order_len: jnp.ndarray  # scalar
+    # dump-at-local-completion snapshots
+    snap_taken: jnp.ndarray  # [N] bool
+    snap_mem: jnp.ndarray
+    snap_dir_state: jnp.ndarray
+    snap_dir_sharers: jnp.ndarray
+    snap_cache_addr: jnp.ndarray
+    snap_cache_val: jnp.ndarray
+    snap_cache_state: jnp.ndarray
+    # bookkeeping (scalars)
+    cycle: jnp.ndarray
+    n_instr: jnp.ndarray
+    n_msgs: jnp.ndarray
+    overflow: jnp.ndarray  # bool: a mailbox exceeded capacity
+
+
+def init_state(
+    config: SystemConfig,
+    traces: Sequence[Sequence[Instr]],
+    replay_order: Optional[Sequence[IssueRecord]] = None,
+    max_trace_len: Optional[int] = None,
+) -> SimState:
+    """Build the initial SoA state (mirrors initializeProcessor,
+    assignment.c:776-822: memory ``(20*id+i) mod 256``, directory all
+    U/empty, caches invalid)."""
+    n, c, m, w = (
+        config.num_procs,
+        config.cache_size,
+        config.mem_size,
+        config.sharer_words,
+    )
+    cap = config.msg_buffer_size
+    t = max(
+        max_trace_len or 0, max((len(tr) for tr in traces), default=0), 1
+    )
+
+    tr_op = np.full((n, t), -1, dtype=np.int32)
+    tr_addr = np.zeros((n, t), dtype=np.int32)
+    tr_val = np.zeros((n, t), dtype=np.int32)
+    tr_len = np.zeros((n,), dtype=np.int32)
+    for i, tr in enumerate(traces):
+        tr_len[i] = len(tr)
+        for j, ins in enumerate(tr):
+            tr_op[i, j] = 0 if ins.op == "R" else 1
+            tr_addr[i, j] = ins.address
+            tr_val[i, j] = ins.value
+
+    if replay_order is not None:
+        order_node = np.array([r.proc for r in replay_order], dtype=np.int32)
+        if order_node.size == 0:
+            order_node = np.array([-1], dtype=np.int32)
+        order_len = np.int32(len(replay_order))
+    else:
+        order_node = np.array([-1], dtype=np.int32)
+        order_len = np.int32(-1)  # -1 = free-run
+
+    mem0 = np.array(
+        [[(20 * i + j) % 256 for j in range(m)] for i in range(n)],
+        dtype=np.int32,
+    )
+
+    return SimState(
+        cache_addr=jnp.full((n, c), INVALID_ADDR, dtype=I32),
+        cache_val=jnp.zeros((n, c), dtype=I32),
+        cache_state=jnp.full((n, c), int(CacheState.INVALID), dtype=I32),
+        mem=jnp.asarray(mem0),
+        dir_state=jnp.full((n, m), int(DirState.U), dtype=I32),
+        dir_sharers=jnp.zeros((n, m, w), dtype=U32),
+        mb_type=jnp.full((n, cap), -1, dtype=I32),
+        mb_sender=jnp.zeros((n, cap), dtype=I32),
+        mb_addr=jnp.zeros((n, cap), dtype=I32),
+        mb_value=jnp.zeros((n, cap), dtype=I32),
+        mb_sharers=jnp.zeros((n, cap, w), dtype=U32),
+        mb_second=jnp.full((n, cap), -1, dtype=I32),
+        mb_head=jnp.zeros((n,), dtype=I32),
+        mb_count=jnp.zeros((n,), dtype=I32),
+        pc=jnp.zeros((n,), dtype=I32),
+        waiting=jnp.zeros((n,), dtype=bool),
+        pending_write=jnp.zeros((n,), dtype=I32),
+        tr_op=jnp.asarray(tr_op),
+        tr_addr=jnp.asarray(tr_addr),
+        tr_val=jnp.asarray(tr_val),
+        tr_len=jnp.asarray(tr_len),
+        order_node=jnp.asarray(order_node),
+        order_pos=jnp.zeros((), dtype=I32),
+        order_len=jnp.asarray(order_len),
+        snap_taken=jnp.zeros((n,), dtype=bool),
+        snap_mem=jnp.asarray(mem0),
+        snap_dir_state=jnp.full((n, m), int(DirState.U), dtype=I32),
+        snap_dir_sharers=jnp.zeros((n, m, w), dtype=U32),
+        snap_cache_addr=jnp.full((n, c), INVALID_ADDR, dtype=I32),
+        snap_cache_val=jnp.zeros((n, c), dtype=I32),
+        snap_cache_state=jnp.full((n, c), int(CacheState.INVALID), dtype=I32),
+        cycle=jnp.zeros((), dtype=I32),
+        n_instr=jnp.zeros((), dtype=I32),
+        n_msgs=jnp.zeros((), dtype=I32),
+        overflow=jnp.zeros((), dtype=bool),
+    )

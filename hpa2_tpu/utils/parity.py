@@ -35,12 +35,32 @@ def replay_run_set(
     config: SystemConfig,
     engine_cls=SpecEngine,
     batched: bool = False,
-) -> SpecEngine:
+):
+    """Replay one run set with any parity-capable engine.
+
+    SpecEngine captures dump candidates natively; JaxEngine does so via
+    its cycle-stepping ``run_capturing_candidates`` mode.
+    """
     traces = load_trace_dir(suite_dir, config)
     order = load_instruction_order(os.path.join(run_dir, "instruction_order.txt"))
-    engine = engine_cls(config, traces, replay_order=order, replay_batched=batched)
-    engine.run()
+    if issubclass(engine_cls, SpecEngine):
+        engine = engine_cls(
+            config, traces, replay_order=order, replay_batched=batched
+        )
+        engine.run()
+    else:
+        if batched:
+            raise ValueError("batched replay is a SpecEngine-only mode")
+        engine = engine_cls(config, traces, replay_order=order)
+        engine.run_capturing_candidates()
     return engine
+
+
+def engine_candidates(engine, node_id: int) -> List[NodeDump]:
+    """Legal dump-timing candidates for one node, engine-agnostic."""
+    if hasattr(engine, "nodes"):  # SpecEngine
+        return list(engine.nodes[node_id].dump_candidates)
+    return list(engine.dump_candidates[node_id])  # JaxEngine
 
 
 def diff_against_fixtures(
@@ -59,20 +79,21 @@ def diff_against_fixtures(
     snapshot.
     """
     diffs: Dict[int, str] = {}
-    for node in engine.nodes:
-        path = os.path.join(run_dir, f"core_{node.id}_output.txt")
+    snapshots = engine.snapshots()
+    for node_id in range(config.num_procs):
+        path = os.path.join(run_dir, f"core_{node_id}_output.txt")
         with open(path, "r") as f:
             expected = f.read()
-        candidates = node.dump_candidates if allow_candidates else []
+        candidates = engine_candidates(engine, node_id) if allow_candidates else []
         if not candidates:
-            candidates = [node.snapshot if node.snapshot else node.dump()]
+            candidates = [snapshots[node_id]]
         rendered = [format_processor_state(c, config) for c in candidates]
         if expected not in rendered:
-            diffs[node.id] = "".join(
+            diffs[node_id] = "".join(
                 difflib.unified_diff(
                     expected.splitlines(keepends=True),
                     rendered[0].splitlines(keepends=True),
-                    fromfile=f"fixture/{os.path.basename(run_dir)}/core_{node.id}",
+                    fromfile=f"fixture/{os.path.basename(run_dir)}/core_{node_id}",
                     tofile="engine",
                 )
             )

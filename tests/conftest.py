@@ -18,6 +18,13 @@ import pathlib
 
 import pytest
 
+# persistent XLA compilation cache: the jitted step/run programs are
+# identical across test runs, so recompiles dominate otherwise
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/hpa2_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 REFERENCE_TESTS = pathlib.Path("/root/reference/tests")
 
