@@ -1,0 +1,131 @@
+"""ctypes binding to the native C++/OpenMP backend (native/).
+
+pybind11 is not available in this environment, so the boundary is the
+small C API in native/src/capi.cpp: run a trace directory (the engine
+writes reference-format ``core_<n>_output.txt`` files) or a synthetic
+benchmark.  Build with ``make -C native`` (done on demand here).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+from hpa2_tpu.config import SystemConfig
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libhpa2sim.so")
+_BIN_PATH = os.path.join(_NATIVE_DIR, "build", "hpa2sim")
+
+
+class Hpa2Result(ctypes.Structure):
+    _fields_ = [
+        ("instructions", ctypes.c_ulonglong),
+        ("messages", ctypes.c_ulonglong),
+        ("cycles", ctypes.c_ulonglong),
+        ("seconds", ctypes.c_double),
+        ("ok", ctypes.c_int),
+        ("error", ctypes.c_char * 256),
+    ]
+
+
+class NativeError(RuntimeError):
+    pass
+
+
+def ensure_built(force: bool = False) -> str:
+    """Build the native backend if needed; returns the library path."""
+    if force or not (os.path.exists(_LIB_PATH) and os.path.exists(_BIN_PATH)):
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR], check=True, capture_output=True
+        )
+    return _LIB_PATH
+
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(ensure_built())
+        lib.hpa2_run_dir.restype = ctypes.c_int
+        lib.hpa2_run_dir.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_ulonglong, ctypes.c_int,
+            ctypes.POINTER(Hpa2Result),
+        ]
+        lib.hpa2_bench_random.restype = ctypes.c_int
+        lib.hpa2_bench_random.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_ulonglong, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(Hpa2Result),
+        ]
+        _lib = lib
+    return _lib
+
+
+def _check_config(config: SystemConfig) -> None:
+    if config.num_procs > 64:
+        raise NativeError(
+            "native backend supports up to 64 nodes (single-word sharer "
+            "mask); use the JAX backend beyond"
+        )
+
+
+def run_trace_dir(
+    config: SystemConfig,
+    trace_dir: str,
+    out_dir: str,
+    mode: str = "lockstep",
+    replay_path: Optional[str] = None,
+    candidates: bool = False,
+    final_dump: bool = False,
+    max_cycles: int = 100_000_000,
+    threads: int = 0,
+) -> Hpa2Result:
+    """Run the native engine on a trace directory.  Dump files are
+    written to ``out_dir`` in the reference format."""
+    _check_config(config)
+    lib = _load()
+    res = Hpa2Result()
+    rc = lib.hpa2_run_dir(
+        trace_dir.encode(), out_dir.encode(),
+        1 if mode == "omp" else 0,
+        config.num_procs, config.cache_size, config.mem_size,
+        config.msg_buffer_size, config.max_instr_num,
+        1 if config.semantics.intervention_miss_policy == "nack" else 0,
+        (replay_path or "").encode(), int(candidates), int(final_dump),
+        max_cycles, threads, ctypes.byref(res),
+    )
+    if rc != 0 or not res.ok:
+        raise NativeError(res.error.decode() or "native run failed")
+    return res
+
+
+def bench_random(
+    config: SystemConfig,
+    instrs_per_core: int,
+    seed: int = 0,
+    mode: str = "omp",
+    threads: int = 0,
+) -> Hpa2Result:
+    """Synthetic uniform-random benchmark; returns counters + wall time."""
+    _check_config(config)
+    lib = _load()
+    res = Hpa2Result()
+    rc = lib.hpa2_bench_random(
+        1 if mode == "omp" else 0,
+        config.num_procs, config.cache_size, config.mem_size,
+        config.msg_buffer_size, instrs_per_core, seed,
+        1 if config.semantics.intervention_miss_policy == "nack" else 0,
+        threads, ctypes.byref(res),
+    )
+    if rc != 0 or not res.ok:
+        raise NativeError(res.error.decode() or "native bench failed")
+    return res

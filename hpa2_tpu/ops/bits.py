@@ -16,10 +16,6 @@ WORD_BITS = 32
 _U32 = jnp.uint32
 
 
-def zero_mask(shape_prefix, words: int):
-    return jnp.zeros((*shape_prefix, words), dtype=_U32)
-
-
 def bit_mask(proc, words: int):
     """One-hot sharer mask for node id(s) ``proc`` (int array [...])
     -> [..., W].  Negative ids produce an all-zero mask."""
@@ -34,14 +30,6 @@ def bit_mask(proc, words: int):
 def test_bit(mask, proc):
     """mask [..., W], proc int [...] -> bool [...]."""
     return jnp.any(mask & bit_mask(proc, mask.shape[-1]) != 0, axis=-1)
-
-
-def set_bit(mask, proc):
-    return mask | bit_mask(proc, mask.shape[-1])
-
-
-def clear_bit(mask, proc):
-    return mask & ~bit_mask(proc, mask.shape[-1])
 
 
 def popcount(mask):
@@ -63,18 +51,6 @@ def find_owner(mask):
     cand = jnp.where(mask != 0, word_idx * WORD_BITS + ctz, big)
     low = jnp.min(cand, axis=-1)
     return jnp.where(low >= big, jnp.int32(-1), low)
-
-
-def is_empty(mask):
-    return jnp.all(mask == 0, axis=-1)
-
-
-def from_int(value: int, words: int):
-    """Python int bitmask -> [W] uint32 array (host-side init)."""
-    return jnp.array(
-        [(value >> (WORD_BITS * w)) & 0xFFFFFFFF for w in range(words)],
-        dtype=_U32,
-    )
 
 
 def to_int(mask) -> int:

@@ -13,6 +13,7 @@ parity tests; the all-on-device path is the production/benchmark one.
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -180,9 +181,6 @@ class JaxEngine:
 
 def stack_states(states: Sequence[SimState]) -> SimState:
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
-
-
-import functools
 
 
 @functools.lru_cache(maxsize=16)

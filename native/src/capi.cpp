@@ -1,0 +1,129 @@
+// C API for the Python ctypes binding (hpa2_tpu/native.py).
+//
+// pybind11 is not available in this environment, so the boundary is a
+// small C surface: run a trace directory (writing reference-format
+// dump files) or a synthetic benchmark, returning counters through an
+// out-struct.
+
+#include "sim.hpp"
+
+#include <chrono>
+#include <cstring>
+#include <fstream>
+
+using namespace hpa2;
+
+extern "C" {
+
+struct Hpa2Result {
+  unsigned long long instructions;
+  unsigned long long messages;
+  unsigned long long cycles;
+  double seconds;
+  int ok;          // 1 = completed (quiescent)
+  char error[256];
+};
+
+static void set_err(Hpa2Result* r, const std::string& e) {
+  r->ok = 0;
+  std::strncpy(r->error, e.c_str(), sizeof(r->error) - 1);
+  r->error[sizeof(r->error) - 1] = 0;
+}
+
+// Run a trace directory; writes core_<n>_output.txt into out_dir.
+// mode: 0 = lockstep, 1 = omp.  replay_path may be NULL.
+int hpa2_run_dir(const char* trace_dir, const char* out_dir, int mode,
+                 int nodes, int cache, int mem, int cap, int max_instr,
+                 int robust, const char* replay_path, int candidates,
+                 int final_dump, unsigned long long max_cycles,
+                 int threads, Hpa2Result* result) {
+  Config cfg;
+  cfg.nodes = nodes;
+  cfg.cache = cache;
+  cfg.mem = mem;
+  cfg.cap = cap;
+  cfg.max_instr = max_instr;
+  cfg.nack = robust != 0;
+  std::memset(result, 0, sizeof(*result));
+  try {
+    auto traces = load_trace_dir(cfg, trace_dir);
+    std::vector<IssueRecord> order;
+    const std::vector<IssueRecord>* order_p = nullptr;
+    if (replay_path && *replay_path) {
+      order = load_instruction_order(replay_path);
+      order_p = &order;
+      mode = 0;
+    }
+    auto t0 = std::chrono::steady_clock::now();
+    RunResult res = (mode == 1)
+                        ? run_omp(cfg, traces, threads)
+                        : run_lockstep(cfg, traces, order_p, max_cycles,
+                                       candidates != 0);
+    auto t1 = std::chrono::steady_clock::now();
+    result->seconds = std::chrono::duration<double>(t1 - t0).count();
+    if (!res.error.empty()) {
+      set_err(result, res.error);
+      return 1;
+    }
+    const auto& dumps = final_dump ? res.finals : res.snapshots;
+    for (int n = 0; n < cfg.nodes; ++n) {
+      std::ofstream f(std::string(out_dir) + "/core_" +
+                      std::to_string(n) + "_output.txt");
+      f << format_dump(cfg, n, dumps[n]);
+      if (candidates) {
+        for (size_t k = 0; k < res.candidates[n].size(); ++k) {
+          std::ofstream cf(std::string(out_dir) + "/core_" +
+                           std::to_string(n) + "_cand_" +
+                           std::to_string(k) + ".txt");
+          cf << format_dump(cfg, n, res.candidates[n][k]);
+        }
+      }
+    }
+    result->instructions = res.counters.instructions;
+    result->messages = res.counters.messages;
+    result->cycles = res.counters.cycles;
+    result->ok = 1;
+    return 0;
+  } catch (const std::exception& e) {
+    set_err(result, e.what());
+    return 1;
+  }
+}
+
+// Synthetic uniform-random benchmark; returns ops/sec via result.
+int hpa2_bench_random(int mode, int nodes, int cache, int mem, int cap,
+                      int instrs_per_core, unsigned long long seed,
+                      int robust, int threads, Hpa2Result* result) {
+  Config cfg;
+  cfg.nodes = nodes;
+  cfg.cache = cache;
+  cfg.mem = mem;
+  cfg.cap = cap;
+  cfg.max_instr = 0;
+  cfg.nack = robust != 0;
+  std::memset(result, 0, sizeof(*result));
+  try {
+    auto traces = gen_uniform_random(cfg, instrs_per_core, seed);
+    auto t0 = std::chrono::steady_clock::now();
+    RunResult res = (mode == 1)
+                        ? run_omp(cfg, traces, threads)
+                        : run_lockstep(cfg, traces, nullptr,
+                                       1'000'000'000ull, false);
+    auto t1 = std::chrono::steady_clock::now();
+    result->seconds = std::chrono::duration<double>(t1 - t0).count();
+    if (!res.error.empty()) {
+      set_err(result, res.error);
+      return 1;
+    }
+    result->instructions = res.counters.instructions;
+    result->messages = res.counters.messages;
+    result->cycles = res.counters.cycles;
+    result->ok = 1;
+    return 0;
+  } catch (const std::exception& e) {
+    set_err(result, e.what());
+    return 1;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,188 @@
+// Trace loading and byte-exact state dumps.
+//
+// Formats are the reference's (README.md:55-68; printProcessorState at
+// assignment.c:824-875) with the fixture-style binary bitVector
+// rendering (SURVEY.md §6.2.1), identical to hpa2_tpu/utils/{trace,
+// dump}.py.
+
+#include "sim.hpp"
+
+#include <cctype>
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+
+namespace hpa2 {
+
+static const char* kCacheStr[] = {"MODIFIED", "EXCLUSIVE", "SHARED",
+                                  "INVALID"};
+static const char* kDirStr[] = {"EM", "S", "U"};
+
+std::vector<std::vector<Instr>> load_trace_dir(const Config& cfg,
+                                               const std::string& dir) {
+  std::vector<std::vector<Instr>> traces(cfg.nodes);
+  for (int n = 0; n < cfg.nodes; ++n) {
+    std::string path = dir + "/core_" + std::to_string(n) + ".txt";
+    std::ifstream f(path);
+    if (!f) throw std::runtime_error("cannot open " + path);
+    std::string line;
+    int lineno = 0;
+    while (std::getline(f, line)) {
+      ++lineno;
+      // trim
+      size_t b = line.find_first_not_of(" \t\r\n");
+      if (b == std::string::npos) continue;  // blank
+      size_t e = line.find_last_not_of(" \t\r\n");
+      std::string s = line.substr(b, e - b + 1);
+      if (cfg.max_instr > 0 &&
+          (int)traces[n].size() >= cfg.max_instr)
+        break;
+      Instr ins{};
+      unsigned addr;
+      unsigned value;
+      int used = -1;
+      // trailing %n + full-consumption check: reject partial parses
+      // like "RD 0xZZ" that bare sscanf would silently accept
+      if (sscanf(s.c_str(), "RD %x %n", &addr, &used) == 1 &&
+          used == (int)s.size() && s.rfind("RD", 0) == 0) {
+        ins.write = false;
+        ins.addr = (int32_t)addr;
+        ins.value = 0;
+      } else if ((used = -1,
+                  sscanf(s.c_str(), "WR %x %u %n", &addr, &value, &used) ==
+                      2) &&
+                 used == (int)s.size() && s.rfind("WR", 0) == 0) {
+        ins.write = true;
+        ins.addr = (int32_t)addr;
+        ins.value = (int32_t)(value % 256);  // %hhu wrap
+      } else {
+        throw std::runtime_error(path + ": malformed trace line " +
+                                 std::to_string(lineno) + ": " + s);
+      }
+      if (ins.addr < 0 || ins.addr >= cfg.num_addresses())
+        throw std::runtime_error(path + ": address out of range at line " +
+                                 std::to_string(lineno));
+      traces[n].push_back(ins);
+    }
+  }
+  return traces;
+}
+
+std::vector<IssueRecord> load_instruction_order(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::vector<IssueRecord> out;
+  std::string line;
+  int lineno = 0;
+  while (std::getline(f, line)) {
+    ++lineno;
+    if (line.find_first_not_of(" \t\r\n") == std::string::npos) continue;
+    int proc, value;
+    char type;
+    unsigned addr;
+    if (sscanf(line.c_str(),
+               "Processor %d: instr type=%c, address=0x%x, value=%d",
+               &proc, &type, &addr, &value) != 4)
+      throw std::runtime_error(path + ": malformed order line " +
+                               std::to_string(lineno));
+    out.push_back({proc, type == 'W', (int32_t)addr, value});
+  }
+  return out;
+}
+
+static std::string binary8(Sharers s) {
+  if (s >> 8)
+    throw std::runtime_error(
+        "sharer mask needs more than 8 binary digits; wide format "
+        "required for nodes > 8");
+  std::string out(8, '0');
+  for (int i = 0; i < 8; ++i)
+    if ((s >> i) & 1) out[7 - i] = '1';
+  return out;
+}
+
+std::string format_dump(const Config& cfg, int proc, const NodeDump& d) {
+  char buf[128];
+  std::string out;
+  if (!cfg.parity_format()) {
+    // scalable wide format (mirrors hpa2_tpu/utils/dump.py:_format_wide)
+    snprintf(buf, sizeof buf,
+             "# hpa2 node dump (wide format) proc=%d nodes=%d mem=%d "
+             "cache=%d\n",
+             proc, cfg.nodes, cfg.mem, cfg.cache);
+    out += buf;
+    out += "[memory]\n";
+    for (int i = 0; i < cfg.mem; ++i) {
+      snprintf(buf, sizeof buf, "%d 0x%x %d\n", i, proc * cfg.mem + i,
+               d.memory[i]);
+      out += buf;
+    }
+    out += "[directory]\n";
+    for (int i = 0; i < cfg.mem; ++i) {
+      int words = (cfg.nodes + 31) / 32;
+      std::string hexwords;
+      for (int w = 0; w < words; ++w) {
+        char hb[16];
+        snprintf(hb, sizeof hb, "%08x",
+                 (uint32_t)((d.dir_sharers[i] >> (32 * w)) & 0xFFFFFFFFu));
+        if (w) hexwords += ",";
+        hexwords += hb;
+      }
+      snprintf(buf, sizeof buf, "%d 0x%x %s %s\n", i, proc * cfg.mem + i,
+               kDirStr[(int)d.dir_state[i]], hexwords.c_str());
+      out += buf;
+    }
+    out += "[cache]\n";
+    for (int i = 0; i < cfg.cache; ++i) {
+      if (d.cache_addr[i] < 0)
+        snprintf(buf, sizeof buf, "%d - %d %s\n", i, d.cache_value[i],
+                 kCacheStr[(int)d.cache_state[i]]);
+      else
+        snprintf(buf, sizeof buf, "%d 0x%x %d %s\n", i, d.cache_addr[i],
+                 d.cache_value[i], kCacheStr[(int)d.cache_state[i]]);
+      out += buf;
+    }
+    return out;
+  }
+
+  out += "=======================================\n";
+  snprintf(buf, sizeof buf, " Processor Node: %d\n", proc);
+  out += buf;
+  out += "=======================================\n\n";
+
+  out += "-------- Memory State --------\n";
+  out += "| Index | Address |   Value  |\n";
+  out += "|----------------------------|\n";
+  for (int i = 0; i < cfg.mem; ++i) {
+    snprintf(buf, sizeof buf, "|  %3d  |  0x%02X   |  %5d   |\n", i,
+             (proc << 4) + i, d.memory[i]);
+    out += buf;
+  }
+  out += "------------------------------\n\n";
+
+  out += "------------ Directory State ---------------\n";
+  out += "| Index | Address | State |    BitVector   |\n";
+  out += "|------------------------------------------|\n";
+  for (int i = 0; i < cfg.mem; ++i) {
+    snprintf(buf, sizeof buf, "|  %3d  |  0x%02X   |  %2s   |   0x%s   |\n",
+             i, (proc << 4) + i, kDirStr[(int)d.dir_state[i]],
+             binary8(d.dir_sharers[i]).c_str());
+    out += buf;
+  }
+  out += "--------------------------------------------\n\n";
+
+  out += "------------ Cache State ----------------\n";
+  out += "| Index | Address | Value |    State    |\n";
+  out += "|---------------------------------------|\n";
+  for (int i = 0; i < cfg.cache; ++i) {
+    int addr = d.cache_addr[i] < 0 ? 0xFF : d.cache_addr[i];
+    snprintf(buf, sizeof buf, "|  %3d  |  0x%02X   |  %3d  |  %8s \t|\n",
+             i, addr, d.cache_value[i], kCacheStr[(int)d.cache_state[i]]);
+    out += buf;
+  }
+  out += "----------------------------------------\n\n";
+  return out;
+}
+
+}  // namespace hpa2

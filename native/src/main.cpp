@@ -1,0 +1,148 @@
+// hpa2sim CLI — native backend driver.
+//
+// Usage:
+//   hpa2sim [options] TRACE_DIR        run a trace directory
+//   hpa2sim [options] --bench INSTRS   synthetic uniform-random bench
+//
+// Options:
+//   --mode lockstep|omp   execution engine (default lockstep)
+//   --nodes N --cache C --mem M --cap K --max-instr I
+//   --robust              NACK stale interventions (heals livelocks)
+//   --replay FILE         lockstep replay of an instruction_order.txt
+//   --candidates          also write every legal dump timing per node
+//   --final               dump quiescent state instead of
+//                         dump-at-local-completion snapshots
+//   --out DIR             output directory (default .)
+//   --threads T           omp mode thread count (default = nodes)
+//   --max-cycles X        lockstep cycle budget
+//   --seed S              bench seed
+//   --json                print a machine-readable result line
+//
+// Output files match the reference exactly: core_<n>_output.txt
+// (assignment.c:824-875; fixture bitVector rendering).
+
+#include "sim.hpp"
+
+#include <chrono>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+
+using namespace hpa2;
+
+static void write_file(const std::string& path, const std::string& text) {
+  std::ofstream f(path);
+  if (!f) throw std::runtime_error("cannot write " + path);
+  f << text;
+}
+
+int main(int argc, char** argv) {
+  Config cfg;
+  std::string mode = "lockstep";
+  std::string trace_dir, replay_path, out_dir = ".";
+  bool candidates = false, final_dump = false, json = false;
+  int bench_instrs = 0, threads = 0;
+  uint64_t seed = 0, max_cycles = 100'000'000ull;
+
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) {
+        std::cerr << "missing value for " << a << "\n";
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--mode") mode = next();
+    else if (a == "--nodes") cfg.nodes = std::stoi(next());
+    else if (a == "--cache") cfg.cache = std::stoi(next());
+    else if (a == "--mem") cfg.mem = std::stoi(next());
+    else if (a == "--cap") cfg.cap = std::stoi(next());
+    else if (a == "--max-instr") cfg.max_instr = std::stoi(next());
+    else if (a == "--robust") cfg.nack = true;
+    else if (a == "--replay") replay_path = next();
+    else if (a == "--candidates") candidates = true;
+    else if (a == "--final") final_dump = true;
+    else if (a == "--out") out_dir = next();
+    else if (a == "--threads") threads = std::stoi(next());
+    else if (a == "--max-cycles") max_cycles = std::stoull(next());
+    else if (a == "--bench") bench_instrs = std::stoi(next());
+    else if (a == "--seed") seed = std::stoull(next());
+    else if (a == "--json") json = true;
+    else if (a.rfind("--", 0) == 0) {
+      std::cerr << "unknown option " << a << "\n";
+      return 2;
+    } else trace_dir = a;
+  }
+
+  if (cfg.nodes < 1 || cfg.nodes > 64) {
+    std::cerr << "native backend supports 1..64 nodes (use the JAX "
+                 "backend beyond)\n";
+    return 2;
+  }
+
+  try {
+    std::vector<std::vector<Instr>> traces;
+    if (bench_instrs > 0) {
+      cfg.max_instr = 0;
+      traces = gen_uniform_random(cfg, bench_instrs, seed);
+    } else if (!trace_dir.empty()) {
+      traces = load_trace_dir(cfg, trace_dir);
+    } else {
+      std::cerr << "usage: hpa2sim [options] TRACE_DIR | --bench N\n";
+      return 2;
+    }
+
+    std::vector<IssueRecord> order;
+    const std::vector<IssueRecord>* order_p = nullptr;
+    if (!replay_path.empty()) {
+      order = load_instruction_order(replay_path);
+      order_p = &order;
+      mode = "lockstep";
+    }
+
+    auto t0 = std::chrono::steady_clock::now();
+    RunResult res = (mode == "omp")
+                        ? run_omp(cfg, traces, threads)
+                        : run_lockstep(cfg, traces, order_p, max_cycles,
+                                       candidates);
+    auto t1 = std::chrono::steady_clock::now();
+    double secs = std::chrono::duration<double>(t1 - t0).count();
+
+    if (!res.error.empty()) {
+      std::cerr << "error: " << res.error << "\n";
+      return 1;
+    }
+
+    if (bench_instrs == 0) {
+      const auto& dumps = final_dump ? res.finals : res.snapshots;
+      for (int n = 0; n < cfg.nodes; ++n) {
+        write_file(out_dir + "/core_" + std::to_string(n) + "_output.txt",
+                   format_dump(cfg, n, dumps[n]));
+        if (candidates) {
+          for (size_t k = 0; k < res.candidates[n].size(); ++k)
+            write_file(out_dir + "/core_" + std::to_string(n) + "_cand_" +
+                           std::to_string(k) + ".txt",
+                       format_dump(cfg, n, res.candidates[n][k]));
+        }
+      }
+    }
+
+    double ops = res.counters.instructions / (secs > 0 ? secs : 1e-9);
+    if (json) {
+      std::cout << "{\"mode\":\"" << mode << "\",\"nodes\":" << cfg.nodes
+                << ",\"instructions\":" << res.counters.instructions
+                << ",\"messages\":" << res.counters.messages
+                << ",\"cycles\":" << res.counters.cycles
+                << ",\"seconds\":" << secs << ",\"ops_per_sec\":" << ops
+                << "}\n";
+    } else if (bench_instrs > 0) {
+      std::cout << mode << ": " << res.counters.instructions
+                << " instrs in " << secs << "s = " << ops << " ops/s\n";
+    }
+    return 0;
+  } catch (const std::exception& e) {
+    std::cerr << "error: " << e.what() << "\n";
+    return 1;
+  }
+}

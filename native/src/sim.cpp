@@ -1,0 +1,747 @@
+// Engine implementation — see sim.hpp for the design overview.
+// Protocol semantics mirror hpa2_tpu/models/spec_engine.py case by
+// case (reference behavior: /root/reference/assignment.c:187-697).
+
+#include "sim.hpp"
+
+#include <omp.h>
+#include <sched.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+
+namespace hpa2 {
+
+namespace {
+
+inline int home_of(const Config& c, int32_t addr) { return addr / c.mem; }
+inline int block_of(const Config& c, int32_t addr) { return addr % c.mem; }
+inline int cindex_of(const Config& c, int32_t addr) { return addr % c.cache; }
+
+inline Sharers bit(int p) { return Sharers(1) << p; }
+inline bool test_bit(Sharers s, int p) { return (s >> p) & 1; }
+inline int popcount(Sharers s) { return __builtin_popcountll(s); }
+inline int find_owner(Sharers s) {
+  return s ? __builtin_ctzll(s) : -1;
+}
+
+struct NodeState {
+  std::vector<CacheLine> cache;
+  std::vector<int32_t> memory;
+  std::vector<DirEntry> directory;
+  const std::vector<Instr>* trace = nullptr;
+  size_t pc = 0;
+  bool waiting = false;
+  int32_t pending = 0;
+
+  void init(const Config& cfg, int id, const std::vector<Instr>& tr) {
+    cache.assign(cfg.cache, CacheLine{});
+    memory.resize(cfg.mem);
+    for (int i = 0; i < cfg.mem; ++i) memory[i] = (20 * id + i) % 256;
+    directory.assign(cfg.mem, DirEntry{});
+    trace = &tr;
+  }
+
+  bool trace_done() const { return pc >= trace->size(); }
+
+  NodeDump dump() const {
+    NodeDump d;
+    for (auto v : memory) d.memory.push_back(v);
+    for (auto& e : directory) {
+      d.dir_state.push_back(e.state);
+      d.dir_sharers.push_back(e.sharers);
+    }
+    for (auto& l : cache) {
+      d.cache_addr.push_back(l.addr);
+      d.cache_value.push_back(l.value);
+      d.cache_state.push_back(l.state);
+    }
+    return d;
+  }
+};
+
+// handleCacheReplacement (spec_engine._replace; assignment.c:742-773)
+template <class SendFn>
+void replace_line(const Config& cfg, int self, const CacheLine& line,
+                  SendFn&& send) {
+  if (line.state == CacheSt::I || line.addr < 0) return;
+  int home = home_of(cfg, line.addr);
+  Msg m{};
+  m.sender = self;
+  m.addr = line.addr;
+  m.second = -1;
+  if (line.state == CacheSt::M) {
+    m.type = EVICT_MODIFIED;
+    m.value = line.value;
+  } else {
+    m.type = EVICT_SHARED;
+  }
+  send(home, m);
+}
+
+// the 13-case protocol switch (spec_engine._handle)
+template <class SendFn>
+void handle_msg(const Config& cfg, int self, NodeState& n, const Msg& msg,
+                SendFn&& send) {
+  const int home = home_of(cfg, msg.addr);
+  const int blk = block_of(cfg, msg.addr);
+  CacheLine& line = n.cache[cindex_of(cfg, msg.addr)];
+  DirEntry* dir = (self == home) ? &n.directory[blk] : nullptr;
+  const bool line_match = line.addr == msg.addr;
+  const bool line_me =
+      line.state == CacheSt::M || line.state == CacheSt::E;
+
+  auto reply = [&](int recv, Msg m) { send(recv, m); };
+
+  switch (msg.type) {
+    case READ_REQUEST: {
+      Msg r{};
+      r.type = REPLY_RD;
+      r.sender = self;
+      r.addr = msg.addr;
+      r.value = n.memory[blk];
+      r.second = -1;
+      if (dir->state == DirSt::U) {
+        dir->state = DirSt::EM;
+        dir->sharers = bit(msg.sender);
+        r.sharers = 2;  // exclusive flag (assignment.c:201)
+        reply(msg.sender, r);
+      } else if (dir->state == DirSt::S) {
+        dir->sharers |= bit(msg.sender);
+        r.sharers = 0;
+        reply(msg.sender, r);
+      } else {
+        int owner = find_owner(dir->sharers);
+        if (owner == msg.sender) {
+          r.sharers = 2;
+          reply(msg.sender, r);
+        } else {
+          Msg f{};
+          f.type = WRITEBACK_INT;
+          f.sender = self;
+          f.addr = msg.addr;
+          f.second = msg.sender;
+          send(owner, f);
+          dir->state = DirSt::S;  // optimistic (assignment.c:230-231)
+          dir->sharers |= bit(msg.sender);
+        }
+      }
+      break;
+    }
+
+    case REPLY_RD: {
+      if (line.addr >= 0 && !line_match && line.state != CacheSt::I)
+        replace_line(cfg, self, line, send);
+      line.addr = msg.addr;
+      line.value = msg.value;
+      line.state = (msg.sharers == 2) ? CacheSt::E : CacheSt::S;
+      n.waiting = false;
+      break;
+    }
+
+    case WRITEBACK_INT: {
+      if (line_match && line_me) {
+        Msg f{};
+        f.type = FLUSH;
+        f.sender = self;
+        f.addr = msg.addr;
+        f.value = line.value;
+        f.second = msg.second;
+        send(home, f);
+        if (msg.second != home) send(msg.second, f);
+        line.state = CacheSt::S;
+      } else if (cfg.nack) {
+        Msg k{};
+        k.type = NACK;
+        k.sender = self;
+        k.addr = msg.addr;
+        k.sharers = 0;  // read intervention
+        k.second = msg.second;
+        send(home, k);
+      }
+      break;
+    }
+
+    case FLUSH: {
+      if (self == home) n.memory[blk] = msg.value;
+      if (self == msg.second) {
+        if (line.addr >= 0 && !line_match && line.state != CacheSt::I)
+          replace_line(cfg, self, line, send);
+        line.addr = msg.addr;
+        line.value = msg.value;
+        line.state = CacheSt::S;
+        n.waiting = false;
+      }
+      break;
+    }
+
+    case UPGRADE: {
+      Msg r{};
+      r.type = REPLY_ID;
+      r.sender = self;
+      r.addr = msg.addr;
+      r.second = -1;
+      r.sharers =
+          (dir->state == DirSt::S) ? (dir->sharers & ~bit(msg.sender)) : 0;
+      reply(msg.sender, r);
+      dir->state = DirSt::EM;
+      dir->sharers = bit(msg.sender);
+      break;
+    }
+
+    case REPLY_ID: {
+      bool fan_out = true;
+      if (line_match && line.state != CacheSt::M) {
+        line.value = n.pending;
+        line.state = CacheSt::M;
+      } else if (!line_match) {
+        fan_out = false;  // replaced while waiting (assignment.c:339-347)
+      }
+      if (fan_out) {
+        for (int i = 0; i < cfg.nodes; ++i) {
+          if (i != self && test_bit(msg.sharers, i)) {
+            Msg inv{};
+            inv.type = INV;
+            inv.sender = self;
+            inv.addr = msg.addr;
+            inv.second = -1;
+            send(i, inv);
+          }
+        }
+      }
+      n.waiting = false;
+      break;
+    }
+
+    case INV: {
+      if (line_match &&
+          (line.state == CacheSt::S || line.state == CacheSt::E))
+        line.state = CacheSt::I;
+      break;
+    }
+
+    case WRITE_REQUEST: {
+      if (cfg.eager_write_request_memory) n.memory[blk] = msg.value;
+      if (dir->state == DirSt::U) {
+        dir->state = DirSt::EM;
+        dir->sharers = bit(msg.sender);
+        Msg r{};
+        r.type = REPLY_WR;
+        r.sender = self;
+        r.addr = msg.addr;
+        r.second = -1;
+        reply(msg.sender, r);
+      } else if (dir->state == DirSt::S) {
+        Msg r{};
+        r.type = REPLY_ID;
+        r.sender = self;
+        r.addr = msg.addr;
+        r.sharers = dir->sharers & ~bit(msg.sender);
+        r.second = -1;
+        reply(msg.sender, r);
+        dir->state = DirSt::EM;
+        dir->sharers = bit(msg.sender);
+      } else {
+        int owner = find_owner(dir->sharers);
+        if (owner == msg.sender) {
+          Msg r{};
+          r.type = REPLY_WR;
+          r.sender = self;
+          r.addr = msg.addr;
+          r.second = -1;
+          reply(msg.sender, r);
+        } else {
+          Msg f{};
+          f.type = WRITEBACK_INV;
+          f.sender = self;
+          f.addr = msg.addr;
+          f.second = msg.sender;
+          send(owner, f);
+          dir->sharers = bit(msg.sender);  // state stays EM (c:429)
+        }
+      }
+      break;
+    }
+
+    case REPLY_WR: {
+      line.addr = msg.addr;
+      line.value = n.pending;
+      line.state = CacheSt::M;
+      n.waiting = false;
+      break;
+    }
+
+    case WRITEBACK_INV: {
+      if (line_match && line_me) {
+        Msg f{};
+        f.type = FLUSH_INVACK;
+        f.sender = self;
+        f.addr = msg.addr;
+        f.value = line.value;
+        f.second = msg.second;
+        send(home, f);
+        if (msg.second != home) send(msg.second, f);
+        line.state = CacheSt::I;
+      } else if (cfg.nack) {
+        Msg k{};
+        k.type = NACK;
+        k.sender = self;
+        k.addr = msg.addr;
+        k.sharers = 1;  // write intervention
+        k.second = msg.second;
+        send(home, k);
+      }
+      break;
+    }
+
+    case FLUSH_INVACK: {
+      if (self == home) {
+        n.memory[blk] = msg.value;
+        dir->state = DirSt::EM;
+        dir->sharers = bit(msg.second);
+      }
+      if (self == msg.second) {
+        line.addr = msg.addr;
+        line.value =
+            cfg.flush_invack_fills_old_value ? msg.value : n.pending;
+        line.state = CacheSt::M;
+        n.waiting = false;
+      }
+      break;
+    }
+
+    case EVICT_SHARED: {
+      if (self == home && test_bit(dir->sharers, msg.sender)) {
+        dir->sharers &= ~bit(msg.sender);
+        int remaining = popcount(dir->sharers);
+        if (remaining == 0) {
+          dir->state = DirSt::U;
+        } else if (remaining == 1 && dir->state == DirSt::S) {
+          dir->state = DirSt::EM;
+          Msg u{};
+          u.type = UPGRADE_NOTIFY;
+          u.sender = self;
+          u.addr = msg.addr;
+          u.second = -1;
+          send(find_owner(dir->sharers), u);
+        }
+      }
+      break;
+    }
+
+    case UPGRADE_NOTIFY: {
+      if (msg.sender == home && line_match && line.state == CacheSt::S)
+        line.state = CacheSt::E;
+      break;
+    }
+
+    case EVICT_MODIFIED: {
+      n.memory[blk] = msg.value;
+      if (dir->state == DirSt::EM && test_bit(dir->sharers, msg.sender)) {
+        dir->sharers = 0;
+        dir->state = DirSt::U;
+      }
+      break;
+    }
+
+    case NACK: {
+      int requester = msg.second;
+      if (msg.sharers == 0) {  // re-serve read from memory
+        dir->state = DirSt::S;
+        dir->sharers |= bit(requester);
+        Msg r{};
+        r.type = REPLY_RD;
+        r.sender = self;
+        r.addr = msg.addr;
+        r.value = n.memory[blk];
+        r.sharers = 0;
+        r.second = -1;
+        send(requester, r);
+      } else {  // re-serve write
+        dir->state = DirSt::EM;
+        dir->sharers = bit(requester);
+        Msg r{};
+        r.type = REPLY_WR;
+        r.sender = self;
+        r.addr = msg.addr;
+        r.second = -1;
+        send(requester, r);
+      }
+      break;
+    }
+  }
+}
+
+// instruction issue (spec_engine._issue; assignment.c:590-697)
+template <class SendFn>
+void issue_one(const Config& cfg, int self, NodeState& n, SendFn&& send) {
+  const Instr& ins = (*n.trace)[n.pc++];
+  const int home = home_of(cfg, ins.addr);
+  CacheLine& line = n.cache[cindex_of(cfg, ins.addr)];
+  const bool hit = line.addr == ins.addr && line.state != CacheSt::I;
+
+  if (!ins.write) {
+    if (hit) return;
+    if (line.addr >= 0 && line.state != CacheSt::I)
+      replace_line(cfg, self, line, send);
+    Msg r{};
+    r.type = READ_REQUEST;
+    r.sender = self;
+    r.addr = ins.addr;
+    r.second = -1;
+    send(home, r);
+    n.waiting = true;
+    line.state = CacheSt::I;  // placeholder (assignment.c:626-628)
+    line.addr = ins.addr;
+    line.value = 0;
+  } else {
+    n.pending = ins.value;
+    if (hit) {
+      if (line.state == CacheSt::M || line.state == CacheSt::E) {
+        line.value = ins.value;
+        line.state = CacheSt::M;  // silent E->M
+      } else {  // SHARED: write applied locally before REPLY_ID
+        Msg u{};
+        u.type = UPGRADE;
+        u.sender = self;
+        u.addr = ins.addr;
+        u.second = -1;
+        send(home, u);
+        line.value = ins.value;
+        line.state = CacheSt::M;
+        n.waiting = true;
+      }
+    } else {
+      if (line.addr >= 0 && line.state != CacheSt::I)
+        replace_line(cfg, self, line, send);
+      Msg r{};
+      r.type = WRITE_REQUEST;
+      r.sender = self;
+      r.addr = ins.addr;
+      r.value = ins.value;
+      r.second = -1;
+      send(home, r);
+      n.waiting = true;
+      line.state = CacheSt::I;
+      line.addr = ins.addr;
+      line.value = 0;
+    }
+  }
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------
+// Deterministic lockstep engine (spec_engine.SpecEngine.step)
+// ---------------------------------------------------------------------
+
+RunResult run_lockstep(const Config& cfg,
+                       const std::vector<std::vector<Instr>>& traces,
+                       const std::vector<IssueRecord>* replay,
+                       uint64_t max_cycles,
+                       bool capture_candidates) {
+  RunResult res;
+  const int N = cfg.nodes;
+  std::vector<NodeState> nodes(N);
+  std::vector<std::deque<Msg>> mailbox(N);
+  for (int i = 0; i < N; ++i) nodes[i].init(cfg, i, traces[i]);
+  res.snapshots.resize(N);
+  res.candidates.resize(N);
+  std::vector<bool> dumped(N, false);
+
+  size_t order_pos = 0;
+  std::vector<std::pair<int, Msg>> outbox;  // (receiver, msg)
+
+  auto quiescent = [&]() {
+    for (int i = 0; i < N; ++i)
+      if (!nodes[i].trace_done() || nodes[i].waiting || !mailbox[i].empty())
+        return false;
+    if (replay && order_pos < replay->size()) return false;
+    return true;
+  };
+
+  uint64_t cycle = 0;
+  int stall = 0;
+  while (true) {
+    bool all_dumped = true;
+    for (int i = 0; i < N; ++i) all_dumped = all_dumped && dumped[i];
+    if (quiescent() && all_dumped) break;
+    if (cycle >= max_cycles) {
+      res.error = "no quiescence after max cycles";
+      res.counters.cycles = cycle;
+      return res;
+    }
+
+    bool progress = false;
+    std::vector<bool> handled(N, false);
+
+    // 1. handle one message per node
+    for (int i = 0; i < N; ++i) {
+      if (mailbox[i].empty()) continue;
+      Msg m = mailbox[i].front();
+      mailbox[i].pop_front();
+      handle_msg(cfg, i, nodes[i], m,
+                 [&](int recv, const Msg& mm) { outbox.emplace_back(recv, mm); });
+      handled[i] = true;
+      progress = true;
+    }
+
+    // 2. issue
+    if (replay) {
+      if (order_pos < replay->size()) {
+        const IssueRecord& rec = (*replay)[order_pos];
+        NodeState& nd = nodes[rec.proc];
+        if (mailbox[rec.proc].empty() && !nd.waiting && !nd.trace_done()) {
+          const Instr& nxt = (*nd.trace)[nd.pc];
+          if (nxt.write != rec.write || nxt.addr != rec.addr) {
+            res.error = "replay order mismatch";
+            return res;
+          }
+          issue_one(cfg, rec.proc, nd, [&](int recv, const Msg& mm) {
+            outbox.emplace_back(recv, mm);
+          });
+          res.counters.instructions++;
+          order_pos++;
+          progress = true;
+        }
+      }
+    } else {
+      for (int i = 0; i < N; ++i) {
+        NodeState& nd = nodes[i];
+        if (mailbox[i].empty() && !nd.waiting && !nd.trace_done()) {
+          issue_one(cfg, i, nd, [&](int recv, const Msg& mm) {
+            outbox.emplace_back(recv, mm);
+          });
+          res.counters.instructions++;
+          progress = true;
+        }
+      }
+    }
+
+    // 3. deliver (already in (phase, sender, emission) order)
+    for (auto& [recv, mm] : outbox) {
+      mailbox[recv].push_back(mm);
+      res.counters.messages++;
+    }
+    outbox.clear();
+
+    // 4. dump-at-local-completion (+ candidate capture)
+    for (int i = 0; i < N; ++i) {
+      NodeState& nd = nodes[i];
+      if (nd.trace_done() && !nd.waiting) {
+        if (!dumped[i]) {
+          if (mailbox[i].empty()) {
+            dumped[i] = true;
+            res.snapshots[i] = nd.dump();
+            if (capture_candidates) res.candidates[i].push_back(res.snapshots[i]);
+            progress = true;
+          }
+        } else if (capture_candidates && handled[i]) {
+          res.candidates[i].push_back(nd.dump());
+        }
+      }
+    }
+
+    ++cycle;
+    if (!progress) {
+      if (++stall > 2) {
+        res.error = "livelock (stale intervention dropped; use --robust)";
+        res.counters.cycles = cycle;
+        return res;
+      }
+    } else {
+      stall = 0;
+    }
+  }
+
+  res.counters.cycles = cycle;
+  for (int i = 0; i < N; ++i) res.finals.push_back(nodes[i].dump());
+  res.completed = true;
+  return res;
+}
+
+// ---------------------------------------------------------------------
+// Free-running OpenMP engine (thread-per-node, quiescence-terminating)
+// ---------------------------------------------------------------------
+
+namespace {
+
+struct RingBox {
+  std::vector<Msg> ring;
+  int head = 0, tail = 0, count = 0;
+  omp_lock_t lock;
+};
+
+}  // namespace
+
+RunResult run_omp(const Config& cfg,
+                  const std::vector<std::vector<Instr>>& traces,
+                  int num_threads) {
+  RunResult res;
+  const int N = cfg.nodes;
+  if (num_threads <= 0) num_threads = N;
+  std::vector<NodeState> nodes(N);
+  std::vector<RingBox> box(N);
+  for (int i = 0; i < N; ++i) {
+    nodes[i].init(cfg, i, traces[i]);
+    box[i].ring.resize(cfg.cap);
+    omp_init_lock(&box[i].lock);
+  }
+  res.snapshots.resize(N);
+  res.candidates.resize(N);
+
+  // quiescence accounting: stable once all traces are exhausted, no
+  // node is waiting, and no message is in flight
+  std::atomic<long> inflight{0};
+  std::atomic<int> undone{N};
+  std::atomic<uint64_t> instr_total{0};
+  std::atomic<bool> aborted{false};  // livelock watchdog (the
+  // reference spins forever on this class; SURVEY.md §6.3)
+
+  auto send = [&](int recv, const Msg& m) {
+    inflight.fetch_add(1, std::memory_order_relaxed);
+    for (;;) {
+      omp_set_lock(&box[recv].lock);
+      if (box[recv].count < cfg.cap) break;
+      omp_unset_lock(&box[recv].lock);  // full: yield and retry (the
+      // reference busy-waits with usleep, c:715-724)
+      sched_yield();
+    }
+    box[recv].ring[box[recv].tail] = m;
+    box[recv].tail = (box[recv].tail + 1) % cfg.cap;
+    box[recv].count++;
+    omp_unset_lock(&box[recv].lock);
+  };
+
+  if (num_threads > N) num_threads = N;
+  std::atomic<uint64_t> msg_total{0};
+  omp_set_num_threads(num_threads);
+#pragma omp parallel
+  {
+    // each thread owns a contiguous block of nodes and round-robins
+    // them: drain-then-issue per node, exactly the reference's loop
+    // shape (assignment.c:153-699) but multiplexed so any thread
+    // count (1..N) works and oversubscription degrades gracefully
+    const int tid = omp_get_thread_num();
+    const int nt = omp_get_num_threads();
+    const int lo = (int)((int64_t)N * tid / nt);
+    const int hi = (int)((int64_t)N * (tid + 1) / nt);
+    std::vector<bool> counted_done(hi - lo, false);
+    std::vector<bool> snapped(hi - lo, false);
+    uint64_t my_instrs = 0, my_msgs = 0;
+    uint64_t idle_spins = 0;
+
+    auto csend = [&](int recv, const Msg& m) {
+      ++my_msgs;
+      send(recv, m);
+    };
+
+    for (;;) {
+      if (aborted.load(std::memory_order_relaxed)) break;
+      bool progressed = false;
+      for (int i = lo; i < hi; ++i) {
+        NodeState& nd = nodes[i];
+        // drain mailbox
+        for (;;) {
+          omp_set_lock(&box[i].lock);
+          if (box[i].count == 0) {
+            omp_unset_lock(&box[i].lock);
+            break;
+          }
+          Msg m = box[i].ring[box[i].head];
+          box[i].head = (box[i].head + 1) % cfg.cap;
+          box[i].count--;
+          omp_unset_lock(&box[i].lock);
+          handle_msg(cfg, i, nd, m, csend);
+          inflight.fetch_sub(1, std::memory_order_release);
+          progressed = true;
+        }
+
+        if (!nd.waiting) {
+          if (!nd.trace_done()) {
+            issue_one(cfg, i, nd, csend);
+            ++my_instrs;
+            progressed = true;
+          } else {
+            if (!snapped[i - lo]) {
+              snapped[i - lo] = true;
+              res.snapshots[i] = nd.dump();
+            }
+            if (!counted_done[i - lo]) {
+              counted_done[i - lo] = true;
+              undone.fetch_sub(1, std::memory_order_release);
+            }
+          }
+        }
+      }
+
+      if (undone.load(std::memory_order_acquire) == 0 &&
+          inflight.load(std::memory_order_acquire) == 0)
+        break;
+
+      if (progressed) {
+        idle_spins = 0;
+      } else {
+        // idle: let peers run (critical when oversubscribed) and
+        // watchdog the reference's livelock class (SURVEY.md §6.3)
+        if (++idle_spins > 20'000'000ull) {
+          aborted.store(true, std::memory_order_relaxed);
+          break;
+        }
+        sched_yield();
+      }
+    }
+    instr_total.fetch_add(my_instrs, std::memory_order_relaxed);
+    msg_total.fetch_add(my_msgs, std::memory_order_relaxed);
+  }
+
+  for (int i = 0; i < N; ++i) omp_destroy_lock(&box[i].lock);
+  res.counters.instructions = instr_total.load();
+  res.counters.messages = msg_total.load();
+  for (int i = 0; i < N; ++i) res.finals.push_back(nodes[i].dump());
+  if (aborted.load()) {
+    res.error = "livelock watchdog fired (stale intervention dropped; "
+                "use --robust)";
+  } else {
+    res.completed = true;
+  }
+  return res;
+}
+
+// ---------------------------------------------------------------------
+// Synthetic workload (splitmix64)
+// ---------------------------------------------------------------------
+
+std::vector<std::vector<Instr>> gen_uniform_random(const Config& cfg,
+                                                   int instrs_per_core,
+                                                   uint64_t seed) {
+  auto next = [](uint64_t& s) {
+    s += 0x9E3779B97F4A7C15ull;
+    uint64_t z = s;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+  };
+  std::vector<std::vector<Instr>> out(cfg.nodes);
+  for (int n = 0; n < cfg.nodes; ++n) {
+    uint64_t s = seed * 1000003ull + n;
+    out[n].reserve(instrs_per_core);
+    for (int k = 0; k < instrs_per_core; ++k) {
+      uint64_t r = next(s);
+      Instr ins;
+      ins.write = (r >> 40) & 1;
+      ins.addr = int32_t(r % uint64_t(cfg.num_addresses()));
+      ins.value = int32_t((r >> 8) % 256);
+      out[n].push_back(ins);
+    }
+  }
+  return out;
+}
+
+}  // namespace hpa2

@@ -1,0 +1,157 @@
+// hpa2sim — native C++/OpenMP backend of the hpa2_tpu framework.
+//
+// A from-scratch reimplementation of the directory-MESI DSM simulator
+// semantics defined by hpa2_tpu/models/spec_engine.py (the executable
+// spec; reference behavior at /root/reference/assignment.c:187-697).
+// Two execution modes:
+//
+//  * Lockstep: the deterministic global-cycle engine (handle one
+//    message per node -> issue -> deliver in (phase, sender, emission)
+//    order -> dump-at-local-completion).  Bit-for-bit equivalent to
+//    the Python spec engine and the JAX backend; supports replaying
+//    recorded instruction_order.txt interleavings.
+//
+//  * Free-running OpenMP: thread-per-node like the reference
+//    (assignment.c:135-153) but with lock-guarded ring mailboxes,
+//    no sleeps, and *global quiescence termination* — the reference
+//    never exits (assignment.c:153; SURVEY.md §2.3).  This mode is the
+//    ops/sec comparison baseline.
+//
+// Fixture semantics are the default (SURVEY.md §6.2): no eager memory
+// write on WRITE_REQUEST, FLUSH_INVACK installs the requester's
+// pending value, and the home->survivor upgrade notification is the
+// distinct UPGRADE_NOTIFY type.  The robust intervention policy
+// (NACK instead of silently dropping a stale WRITEBACK_*) is
+// selectable, as in the other backends.
+
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <string>
+#include <vector>
+
+namespace hpa2 {
+
+enum class CacheSt : int8_t { M = 0, E = 1, S = 2, I = 3 };
+enum class DirSt : int8_t { EM = 0, S = 1, U = 2 };
+
+enum MsgType : int8_t {
+  READ_REQUEST = 0,
+  WRITE_REQUEST = 1,
+  REPLY_RD = 2,
+  REPLY_WR = 3,
+  REPLY_ID = 4,
+  INV = 5,
+  UPGRADE = 6,
+  WRITEBACK_INV = 7,
+  WRITEBACK_INT = 8,
+  FLUSH = 9,
+  FLUSH_INVACK = 10,
+  EVICT_SHARED = 11,
+  EVICT_MODIFIED = 12,
+  UPGRADE_NOTIFY = 13,  // rebuild extension (fixture semantics)
+  NACK = 14,            // rebuild extension (robust mode)
+};
+
+struct Config {
+  int nodes = 4;
+  int cache = 4;
+  int mem = 16;
+  int cap = 256;        // mailbox capacity (ring size)
+  int max_instr = 32;   // 0 = uncapped
+  bool nack = false;    // robust intervention policy
+  bool eager_write_request_memory = false;  // HEAD quirk
+  bool flush_invack_fills_old_value = false;  // HEAD quirk
+
+  int num_addresses() const { return nodes * mem; }
+  bool parity_format() const {
+    return mem == 16 && nodes <= 8 && num_addresses() <= 0xFF;
+  }
+};
+
+// Sharer sets are a single 64-bit word in the native backend (node
+// count <= 64; the Python/JAX backends scale further via multi-word
+// masks).
+using Sharers = uint64_t;
+
+struct Msg {
+  int8_t type;
+  int32_t sender;
+  int32_t addr;
+  int32_t value;
+  Sharers sharers;
+  int32_t second;
+};
+
+struct Instr {
+  bool write;
+  int32_t addr;
+  int32_t value;
+};
+
+struct CacheLine {
+  int32_t addr = -1;
+  int32_t value = 0;
+  CacheSt state = CacheSt::I;
+};
+
+struct DirEntry {
+  DirSt state = DirSt::U;
+  Sharers sharers = 0;
+};
+
+struct NodeDump {
+  std::vector<int32_t> memory;
+  std::vector<DirSt> dir_state;
+  std::vector<Sharers> dir_sharers;
+  std::vector<int32_t> cache_addr;
+  std::vector<int32_t> cache_value;
+  std::vector<CacheSt> cache_state;
+};
+
+struct Counters {
+  uint64_t instructions = 0;
+  uint64_t messages = 0;
+  uint64_t cycles = 0;
+};
+
+struct IssueRecord {
+  int proc;
+  bool write;
+  int32_t addr;
+  int32_t value;
+};
+
+// ---- I/O (byte-exact with the reference formats) --------------------
+std::vector<std::vector<Instr>> load_trace_dir(const Config& cfg,
+                                               const std::string& dir);
+std::vector<IssueRecord> load_instruction_order(const std::string& path);
+std::string format_dump(const Config& cfg, int proc, const NodeDump& d);
+
+// ---- engines --------------------------------------------------------
+struct RunResult {
+  std::vector<NodeDump> snapshots;               // dump-at-local-completion
+  std::vector<NodeDump> finals;                  // quiescent state
+  std::vector<std::vector<NodeDump>> candidates; // legal dump timings
+  Counters counters;
+  bool completed = false;   // reached quiescence
+  std::string error;
+};
+
+RunResult run_lockstep(const Config& cfg,
+                       const std::vector<std::vector<Instr>>& traces,
+                       const std::vector<IssueRecord>* replay,
+                       uint64_t max_cycles,
+                       bool capture_candidates);
+
+RunResult run_omp(const Config& cfg,
+                  const std::vector<std::vector<Instr>>& traces,
+                  int num_threads /* 0 = one per node */);
+
+// synthetic workloads for benchmarking (LCG-based, deterministic)
+std::vector<std::vector<Instr>> gen_uniform_random(const Config& cfg,
+                                                   int instrs_per_core,
+                                                   uint64_t seed);
+
+}  // namespace hpa2

@@ -1,0 +1,167 @@
+"""Native C++/OpenMP backend tests: build, fixture parity, differential
+vs the Python spec oracle, and free-running termination.
+"""
+
+import glob
+import os
+import subprocess
+
+import pytest
+
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.models.spec_engine import SpecEngine
+from hpa2_tpu.utils.dump import format_processor_state
+from hpa2_tpu.utils.parity import discover_run_sets
+from hpa2_tpu.utils.trace import gen_uniform_random, load_trace_dir
+from hpa2_tpu import native
+
+CONFIG = SystemConfig()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def built():
+    native.ensure_built()
+
+
+def write_traces(traces, dirpath):
+    os.makedirs(dirpath, exist_ok=True)
+    for n, tr in enumerate(traces):
+        with open(os.path.join(dirpath, f"core_{n}.txt"), "w") as f:
+            for ins in tr:
+                if ins.op == "R":
+                    f.write(f"RD 0x{ins.address:02X}\n")
+                else:
+                    f.write(f"WR 0x{ins.address:02X} {ins.value}\n")
+
+
+@pytest.mark.parametrize("suite", ["sample", "test_1", "test_2"])
+def test_lockstep_deterministic_fixture_parity(
+    reference_tests_dir, suite, tmp_path
+):
+    res = native.run_trace_dir(
+        CONFIG, str(reference_tests_dir / suite), str(tmp_path)
+    )
+    assert res.ok
+    for n in range(4):
+        got = (tmp_path / f"core_{n}_output.txt").read_text()
+        want = (reference_tests_dir / suite / f"core_{n}_output.txt").read_text()
+        assert got == want, f"{suite} core_{n}"
+
+
+@pytest.mark.parametrize("suite", ["test_3", "test_4"])
+def test_lockstep_replay_candidate_parity(reference_tests_dir, suite, tmp_path):
+    suite_dir = str(reference_tests_dir / suite)
+    for run_dir in discover_run_sets(suite_dir):
+        out = tmp_path / os.path.basename(run_dir)
+        out.mkdir()
+        res = native.run_trace_dir(
+            CONFIG,
+            suite_dir,
+            str(out),
+            replay_path=os.path.join(run_dir, "instruction_order.txt"),
+            candidates=True,
+        )
+        assert res.ok
+        for n in range(4):
+            want = open(os.path.join(run_dir, f"core_{n}_output.txt")).read()
+            cands = [
+                open(p).read()
+                for p in sorted(glob.glob(str(out / f"core_{n}_cand_*.txt")))
+            ]
+            if (
+                os.path.relpath(run_dir, str(reference_tests_dir))
+                == "test_4/run_1"
+                and n == 2
+            ):
+                # documented fixture anomaly (test_fixture_anomaly.py)
+                assert want not in cands
+            else:
+                assert want in cands, f"{run_dir} core_{n}"
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_lockstep_differential_random(tmp_path, seed):
+    cfg = SystemConfig(
+        num_procs=8, max_instr_num=0, semantics=Semantics().robust()
+    )
+    traces = gen_uniform_random(cfg, 80, seed=seed)
+    tdir = tmp_path / "traces"
+    write_traces(traces, str(tdir))
+    out = tmp_path / "out"
+    out.mkdir()
+    res = native.run_trace_dir(cfg, str(tdir), str(out), final_dump=True)
+    assert res.ok
+    spec = SpecEngine(cfg, traces)
+    spec.run()
+    assert res.cycles == spec.cycle
+    assert res.instructions == spec.counters["instructions"]
+    for n, dump in enumerate(spec.final_dumps()):
+        got = (out / f"core_{n}_output.txt").read_text()
+        assert got == format_processor_state(dump, cfg), f"core_{n}"
+
+
+def test_omp_deterministic_suites_match_fixtures(
+    reference_tests_dir, tmp_path
+):
+    """Node-local-only suites are scheduling-independent: the
+    free-running OpenMP engine must reproduce fixtures exactly."""
+    for suite in ["test_1", "test_2"]:
+        out = tmp_path / suite
+        out.mkdir()
+        res = native.run_trace_dir(
+            CONFIG, str(reference_tests_dir / suite), str(out), mode="omp"
+        )
+        assert res.ok
+        for n in range(4):
+            got = (out / f"core_{n}_output.txt").read_text()
+            want = (
+                reference_tests_dir / suite / f"core_{n}_output.txt"
+            ).read_text()
+            assert got == want
+
+
+def test_omp_terminates_on_cross_node_traffic(tmp_path):
+    """The reference never terminates and livelocks on test_4-style
+    traces (SURVEY.md §6.3); the rebuilt free-running engine reaches
+    quiescence with the robust policy."""
+    cfg = SystemConfig(
+        num_procs=4, max_instr_num=0, semantics=Semantics().robust()
+    )
+    traces = gen_uniform_random(cfg, 200, seed=7)
+    tdir = tmp_path / "traces"
+    write_traces(traces, str(tdir))
+    out = tmp_path / "out"
+    out.mkdir()
+    res = native.run_trace_dir(cfg, str(tdir), str(out), mode="omp")
+    assert res.ok and res.instructions == 800
+
+
+def test_native_bench_counters():
+    cfg = SystemConfig(max_instr_num=0, semantics=Semantics().robust())
+    res = native.bench_random(cfg, 500, seed=1, mode="lockstep")
+    assert res.ok and res.instructions == 2000
+    assert res.seconds > 0
+
+
+def test_native_rejects_too_many_nodes():
+    cfg = SystemConfig(num_procs=65, mem_size=16)
+    with pytest.raises(native.NativeError):
+        native.bench_random(cfg, 10)
+
+
+def test_cli_runs_like_reference(reference_tests_dir, tmp_path):
+    """CLI shape: hpa2sim TRACE_DIR writes core_<n>_output.txt to CWD
+    (README.md:99-106 usage, minus the never-terminating loop)."""
+    bin_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "native", "build", "hpa2sim",
+    )
+    proc = subprocess.run(
+        [bin_path, str(reference_tests_dir / "sample")],
+        cwd=str(tmp_path),
+        capture_output=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    want = (reference_tests_dir / "sample" / "core_0_output.txt").read_text()
+    assert (tmp_path / "core_0_output.txt").read_text() == want
