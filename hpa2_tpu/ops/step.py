@@ -24,6 +24,17 @@ spec); this module is its TPU-native lowering:
 
 Replay mode gates issue on a recorded ``instruction_order.txt``
 schedule so fixture interleavings are reproducible under ``jit``.
+
+Multi-chip: ``build_step(config, axis_name=..., shards=D)`` builds the
+*same* cycle as a per-shard SPMD program for ``jax.shard_map`` over a
+mesh axis holding ``num_procs / D`` nodes per device.  Phases A/B/D are
+purely node-local; phase C's delivery — the reference's shared-memory
+mailbox enqueue (assignment.c:711-739) — becomes one ``all_gather`` of
+the fixed-shape candidate tensor over ICI, after which every shard
+scatters its own receivers' messages locally.  Candidate order is
+preserved exactly (shards hold contiguous node blocks, and the gather
+is tiled in axis order), so the sharded engine is bit-identical to the
+single-chip one (see tests/test_parallel.py).
 """
 
 from __future__ import annotations
@@ -119,8 +130,19 @@ def build_step_jitted(config: SystemConfig, replay: bool = False):
     return jax.jit(build_step(config, replay=replay))
 
 
-def build_step(config: SystemConfig, replay: bool = False):
-    """Build the single-system step function (vmap for batches)."""
+def build_step(
+    config: SystemConfig,
+    replay: bool = False,
+    axis_name: Optional[str] = None,
+    shards: int = 1,
+):
+    """Build the single-system step function (vmap for batches).
+
+    With ``axis_name``/``shards`` the returned function is the
+    per-shard SPMD body for ``jax.shard_map``: every node-leading array
+    it sees is the local block of ``num_procs // shards`` nodes, and
+    phase C all-gathers send candidates over the mesh axis.
+    """
     n = config.num_procs
     c = config.cache_size
     m = config.mem_size
@@ -133,10 +155,28 @@ def build_step(config: SystemConfig, replay: bool = False):
             "overloaded EVICT_SHARED notify (HEAD quirk) is available "
             "in the Python spec engine for differential study"
         )
+    if axis_name is not None:
+        if replay:
+            raise ValueError(
+                "replay mode is single-shard only (fixture replays are "
+                "tiny 4-node systems; shard the batch axis instead)"
+            )
+        if shards < 1 or n % shards != 0:
+            raise ValueError(
+                f"num_procs={n} not divisible by shards={shards}"
+            )
     nack = sem.intervention_miss_policy == "nack"
-    node_ids = jnp.arange(n, dtype=I32)
+    n_local = n // shards
+    local_ids = jnp.arange(n_local, dtype=I32)
 
     def step(st: SimState) -> SimState:
+        if axis_name is None:
+            node_ids = local_ids
+        else:
+            node_ids = (
+                jax.lax.axis_index(axis_name).astype(I32) * n_local
+                + local_ids
+            )
         # ============== phase A: handle one message per node ==========
         has_msg = st.mb_count > 0
         head = st.mb_head
@@ -170,18 +210,18 @@ def build_step(config: SystemConfig, replay: bool = False):
         owner_is_snd = owner == snd
         snd_bit = bits.bit_mask(snd, w)
 
-        sA0 = _SendSlots(n, w)
-        sA1 = _SendSlots(n, w)
-        inv_valid = jnp.zeros((n,), dtype=bool)
-        inv_sharers = jnp.zeros((n, w), dtype=U32)
-        inv_addr = jnp.zeros((n,), dtype=I32)
+        sA0 = _SendSlots(n_local, w)
+        sA1 = _SendSlots(n_local, w)
+        inv_valid = jnp.zeros((n_local,), dtype=bool)
+        inv_sharers = jnp.zeros((n_local, w), dtype=U32)
+        inv_addr = jnp.zeros((n_local,), dtype=I32)
 
         # accumulated updates (start = current values)
         nl_addr, nl_val, nl_state = line_addr, line_val, line_state
-        upd_line = jnp.zeros((n,), dtype=bool)
+        upd_line = jnp.zeros((n_local,), dtype=bool)
         nd_state, nd_sharers = ds, dsh
-        upd_dir = jnp.zeros((n,), dtype=bool)
-        mem_write = jnp.zeros((n,), dtype=bool)
+        upd_dir = jnp.zeros((n_local,), dtype=bool)
+        mem_write = jnp.zeros((n_local,), dtype=bool)
         mem_val = mem_blk
         waiting = st.waiting
 
@@ -341,7 +381,7 @@ def build_step(config: SystemConfig, replay: bool = False):
             sA0.put(
                 mk & ~(line_match & line_me), recv=home,
                 type_=int(MsgType.NACK), addr=a,
-                sharers=jnp.ones((n, 1), dtype=U32)
+                sharers=jnp.ones((n_local, 1), dtype=U32)
                 * jnp.eye(1, w, dtype=U32)[0][None, :],
                 second=sr,
             )
@@ -448,8 +488,8 @@ def build_step(config: SystemConfig, replay: bool = False):
         is_rd = elig & (op == 0)
         is_wr = elig & (op == 1)
 
-        sB0 = _SendSlots(n, w)
-        sB1 = _SendSlots(n, w)
+        sB0 = _SendSlots(n_local, w)
+        sB1 = _SendSlots(n_local, w)
 
         rm = is_rd & ~hit
         wm = is_wr & ~hit
@@ -496,17 +536,19 @@ def build_step(config: SystemConfig, replay: bool = False):
                     if name == "valid":
                         cols.append(inv_valid)
                     elif name == "recv":
-                        cols.append(jnp.full((n,), -1, dtype=I32))
+                        cols.append(jnp.full((n_local,), -1, dtype=I32))
                     elif name == "type":
-                        cols.append(jnp.full((n,), int(MsgType.INV), dtype=I32))
+                        cols.append(
+                            jnp.full((n_local,), int(MsgType.INV), dtype=I32)
+                        )
                     elif name == "addr":
                         cols.append(inv_addr)
                     else:
-                        cols.append(jnp.zeros((n,), dtype=I32))
+                        cols.append(jnp.zeros((n_local,), dtype=I32))
                 fields[name] = jnp.stack(cols, axis=1).reshape(-1)
             shcols = [s.sharers for s in slots_list]
             if inv is not None:
-                shcols.append(jnp.zeros((n, w), dtype=U32))
+                shcols.append(jnp.zeros((n_local, w), dtype=U32))
             fields["sharers"] = jnp.stack(shcols, axis=1).reshape(-1, w)
             k = len(slots_list) + (1 if inv is not None else 0)
             fields["sender"] = jnp.repeat(node_ids, k)
@@ -515,12 +557,26 @@ def build_step(config: SystemConfig, replay: bool = False):
                     [False] * len(slots_list)
                     + ([True] if inv is not None else [])
                 ),
-                n,
+                n_local,
             )
             return fields
 
         fa = stack_slots([sA0, sA1], inv=True)
         fb = stack_slots([sB0, sB1])
+        if axis_name is None:
+            inv_all = inv_sharers
+        else:
+            # the mailbox-enqueue boundary (assignment.c:711-739) as an
+            # ICI collective: every shard contributes its candidate
+            # block; tiled gather in axis order keeps the global
+            # candidate order identical to the single-chip engine
+            # (shards own contiguous node blocks, phase A before B)
+            def _gather(x):
+                return jax.lax.all_gather(x, axis_name, tiled=True)
+
+            fa = {key: _gather(val) for key, val in fa.items()}
+            fb = {key: _gather(val) for key, val in fb.items()}
+            inv_all = _gather(inv_sharers)
         f = {
             key: jnp.concatenate([fa[key], fb[key]], axis=0)
             for key in fa
@@ -533,7 +589,7 @@ def build_step(config: SystemConfig, replay: bool = False):
         # sender's inv mask
         inv_mask_j = jnp.where(
             f["is_inv"][:, None],
-            inv_sharers[f["sender"]],
+            inv_all[f["sender"]],
             jnp.zeros((j, w), dtype=U32),
         )  # [J, W]
         r_word = node_ids // 32
@@ -550,11 +606,11 @@ def build_step(config: SystemConfig, replay: bool = False):
         # out-of-range index for invalid candidates -> dropped
         pos = jnp.where(valid_rj, pos, cap)
 
-        r_idx = jnp.broadcast_to(node_ids[:, None], (n, j))
+        r_idx = jnp.broadcast_to(local_ids[:, None], (n_local, j))
 
         def scatter(buf, vals):
             return buf.at[r_idx, pos].set(
-                jnp.broadcast_to(vals[None, :], (n, j)), mode="drop"
+                jnp.broadcast_to(vals[None, :], (n_local, j)), mode="drop"
             )
 
         mb_type = scatter(st.mb_type, f["type"])
@@ -563,13 +619,21 @@ def build_step(config: SystemConfig, replay: bool = False):
         mb_value = scatter(st.mb_value, f["value"])
         mb_second = scatter(st.mb_second, f["second"])
         mb_sharers = st.mb_sharers.at[r_idx, pos].set(
-            jnp.broadcast_to(f["sharers"][None, :, :], (n, j, w)),
+            jnp.broadcast_to(f["sharers"][None, :, :], (n_local, j, w)),
             mode="drop",
         )
 
         delivered = jnp.sum(valid_rj.astype(I32), axis=1)
         mb_count3 = mb_count2 + delivered
-        overflow = st.overflow | jnp.any(mb_count3 > cap)
+        ov_now = jnp.any(mb_count3 > cap)
+        instr_inc = jnp.sum(elig.astype(I32))
+        msgs_inc = jnp.sum(delivered)
+        if axis_name is not None:
+            # replicate the global counters so out_specs stay P()
+            ov_now = jax.lax.psum(ov_now.astype(I32), axis_name) > 0
+            instr_inc = jax.lax.psum(instr_inc, axis_name)
+            msgs_inc = jax.lax.psum(msgs_inc, axis_name)
+        overflow = st.overflow | ov_now
 
         # ============== phase D: dump-at-local-completion =============
         done_node = (pc >= st.tr_len) & ~waiting & (mb_count3 == 0)
@@ -616,8 +680,8 @@ def build_step(config: SystemConfig, replay: bool = False):
             snap_cache_val=snap_cache_val,
             snap_cache_state=snap_cache_state,
             cycle=st.cycle + 1,
-            n_instr=st.n_instr + jnp.sum(elig.astype(I32)),
-            n_msgs=st.n_msgs + jnp.sum(delivered),
+            n_instr=st.n_instr + instr_inc,
+            n_msgs=st.n_msgs + msgs_inc,
             overflow=overflow,
         )
 

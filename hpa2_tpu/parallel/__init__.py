@@ -1,1 +1,17 @@
-"""Device-mesh sharding of the batch and node axes (shard_map / pjit)."""
+"""Multi-chip parallelism: meshes, shardings, and sharded run loops."""
+
+from hpa2_tpu.parallel.sharding import (
+    GridEngine,
+    NodeShardedEngine,
+    build_node_sharded_run,
+    make_mesh,
+    state_specs,
+)
+
+__all__ = [
+    "GridEngine",
+    "NodeShardedEngine",
+    "build_node_sharded_run",
+    "make_mesh",
+    "state_specs",
+]

@@ -1,0 +1,295 @@
+"""Multi-chip execution: mesh construction, `SimState` partition specs,
+and sharded run loops.
+
+The reference scales by OpenMP threads inside one address space
+(assignment.c:125, 135-137) and communicates through locked
+shared-memory mailboxes (assignment.c:63-68, 711-739).  On TPU the two
+scaling axes become mesh axes:
+
+* ``data`` — the ensemble/batch axis: B independent simulated systems,
+  embarrassingly parallel (the DP analog).  Sharding the leading batch
+  axis with a ``NamedSharding`` is enough; XLA needs no collectives.
+* ``node`` — the simulated-node axis *within* one system (the TP/SP
+  analog): each device owns a contiguous block of nodes — their
+  caches, directory slices, memory slices and mailboxes.  Cross-device
+  message delivery is one ``all_gather`` of the fixed-shape send
+  candidate tensor per cycle over ICI (see ops/step.py phase C); the
+  gather order is chosen so the sharded engine is *bit-identical* to
+  the single-chip engine.
+
+Both axes compose: ``shard_map(vmap(step))`` over a 2-D
+``Mesh(('data', 'node'))`` runs a sharded ensemble of sharded systems.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hpa2_tpu.config import SystemConfig
+from hpa2_tpu.models.protocol import Instr
+from hpa2_tpu.models.spec_engine import StallError
+from hpa2_tpu.ops.engine import JaxEngine, _node_dump_from, stack_states
+from hpa2_tpu.ops.state import SimState, init_state
+from hpa2_tpu.ops.step import build_step, quiescent
+from hpa2_tpu.utils.dump import NodeDump
+
+# SimState fields whose leading (non-batch) axis is the node axis;
+# everything else (cycle, counters, replay schedule) is replicated.
+_NODE_LEADING = frozenset(
+    f
+    for f in SimState._fields
+    if f not in ("order_node", "order_pos", "order_len",
+                 "cycle", "n_instr", "n_msgs", "overflow")
+)
+
+
+def make_mesh(
+    node_shards: int = 1,
+    data_shards: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """A ``(data, node)`` mesh over the available devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if node_shards < 1 or node_shards > len(devices):
+        raise ValueError(
+            f"node_shards={node_shards} outside 1..{len(devices)} "
+            "available devices"
+        )
+    if data_shards is None:
+        data_shards = len(devices) // node_shards
+    need = data_shards * node_shards
+    if need < 1:
+        raise ValueError(
+            f"empty mesh: data_shards={data_shards} x "
+            f"node_shards={node_shards}"
+        )
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {data_shards}x{node_shards} needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    grid = np.array(devices[:need]).reshape(data_shards, node_shards)
+    return Mesh(grid, ("data", "node"))
+
+
+def state_specs(
+    batched: bool = False,
+    node_axis: Optional[str] = "node",
+    batch_axis: Optional[str] = "data",
+) -> SimState:
+    """PartitionSpecs for every SimState leaf.
+
+    ``batched=True`` expects a leading ensemble axis on every leaf
+    (from ``stack_states``) sharded over ``batch_axis``; the node axis
+    (leading axis of per-system arrays) shards over ``node_axis``.
+    """
+    lead = (batch_axis,) if batched else ()
+    specs = {}
+    for f in SimState._fields:
+        if f in _NODE_LEADING:
+            specs[f] = P(*lead, node_axis)
+        else:
+            specs[f] = P(*lead)
+    return SimState(**specs)
+
+
+def _place(state: SimState, mesh: Mesh, specs: SimState) -> SimState:
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def build_node_sharded_run(
+    config: SystemConfig,
+    mesh: Mesh,
+    batched: bool,
+    max_cycles: int = 1_000_000,
+):
+    """Jitted run-to-quiescence with the node axis sharded over the
+    mesh's ``node`` axis (and, if ``batched``, the ensemble over
+    ``data``).
+
+    The ``lax.while_loop`` lives *outside* the ``shard_map``: the loop
+    body is the manually-sharded SPMD step (one ICI all_gather per
+    cycle), while the quiescence condition is computed on the global
+    view so XLA inserts the cross-device reductions itself.
+    """
+    node_shards = mesh.shape["node"]
+    step = build_step(
+        config, replay=False, axis_name="node", shards=node_shards
+    )
+    specs = state_specs(batched=batched)
+    body = step
+    if batched:
+        body = jax.vmap(step)
+    wrapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs=specs,
+        check_vma=False,
+    )
+
+    if batched:
+        vq = jax.vmap(quiescent)
+
+        def cond(st):
+            return (
+                jnp.any(~vq(st))
+                & jnp.all(st.cycle < max_cycles)
+                & ~jnp.any(st.overflow)
+            )
+
+    else:
+
+        def cond(st):
+            return (
+                (~quiescent(st))
+                & (st.cycle < max_cycles)
+                & (~st.overflow)
+            )
+
+    def run(st: SimState) -> SimState:
+        return jax.lax.while_loop(cond, wrapped, st)
+
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs
+    )
+    return jax.jit(run, in_shardings=(shardings,), out_shardings=shardings)
+
+
+class NodeShardedEngine:
+    """One large system with its node axis sharded across devices.
+
+    The scaling analog of the reference's thread-per-node OpenMP region
+    (assignment.c:135-137) when one chip is not enough nodes: each
+    device simulates ``num_procs / node_shards`` nodes; mailbox traffic
+    crosses ICI as an all-gathered candidate tensor.  Dump readback and
+    quiescence semantics match :class:`JaxEngine` exactly.
+    """
+
+    def __init__(
+        self,
+        config: SystemConfig,
+        traces: Sequence[Sequence[Instr]],
+        mesh: Optional[Mesh] = None,
+        max_cycles: int = 1_000_000,
+    ):
+        if mesh is None:
+            mesh = make_mesh(node_shards=len(jax.devices()))
+        if config.num_procs % mesh.shape["node"] != 0:
+            raise ValueError(
+                f"num_procs={config.num_procs} not divisible by node "
+                f"shards={mesh.shape['node']}"
+            )
+        self.config = config
+        self.mesh = mesh
+        self._specs = state_specs(batched=False)
+        self.state = _place(init_state(config, traces), mesh, self._specs)
+        self._run = build_node_sharded_run(
+            config, mesh, batched=False, max_cycles=max_cycles
+        )
+
+    def run(self) -> "NodeShardedEngine":
+        st = self._run(self.state)
+        st = jax.tree_util.tree_map(lambda x: x.block_until_ready(), st)
+        self.state = st
+        if bool(st.overflow):
+            raise StallError("mailbox capacity exceeded; raise msg_buffer_size")
+        if not bool(quiescent(st)):
+            raise StallError(
+                f"no quiescence after {int(st.cycle)} cycles (livelock?)"
+            )
+        return self
+
+    def snapshots(self) -> List[NodeDump]:
+        arrs = JaxEngine._snap_arrays(self.state)
+        return [
+            _node_dump_from(arrs, i) for i in range(self.config.num_procs)
+        ]
+
+    def final_dumps(self) -> List[NodeDump]:
+        arrs = JaxEngine._live_arrays(self.state)
+        return [
+            _node_dump_from(arrs, i) for i in range(self.config.num_procs)
+        ]
+
+    @property
+    def cycle(self) -> int:
+        return int(self.state.cycle)
+
+    @property
+    def instructions(self) -> int:
+        return int(self.state.n_instr)
+
+    @property
+    def messages(self) -> int:
+        return int(self.state.n_msgs)
+
+
+class GridEngine:
+    """A sharded ensemble of (optionally) sharded systems: the full 2-D
+    ``(data, node)`` mesh — DP x model-parallel in one jitted loop."""
+
+    def __init__(
+        self,
+        config: SystemConfig,
+        batch_traces: Sequence[Sequence[Sequence[Instr]]],
+        mesh: Optional[Mesh] = None,
+        max_cycles: int = 1_000_000,
+    ):
+        if mesh is None:
+            mesh = make_mesh(node_shards=1)
+        b = len(batch_traces)
+        if b % mesh.shape["data"] != 0:
+            raise ValueError(
+                f"batch {b} not divisible by data shards "
+                f"{mesh.shape['data']}"
+            )
+        if config.num_procs % mesh.shape["node"] != 0:
+            raise ValueError(
+                f"num_procs={config.num_procs} not divisible by node "
+                f"shards={mesh.shape['node']}"
+            )
+        self.config = config
+        self.mesh = mesh
+        max_t = max(
+            (len(tr) for traces in batch_traces for tr in traces), default=1
+        )
+        self._specs = state_specs(batched=True)
+        state = stack_states(
+            [init_state(config, t, max_trace_len=max_t) for t in batch_traces]
+        )
+        self.state = _place(state, mesh, self._specs)
+        self._run = build_node_sharded_run(
+            config, mesh, batched=True, max_cycles=max_cycles
+        )
+
+    def run(self) -> "GridEngine":
+        st = self._run(self.state)
+        st = jax.tree_util.tree_map(lambda x: x.block_until_ready(), st)
+        self.state = st
+        if bool(jnp.any(st.overflow)):
+            raise StallError("mailbox capacity exceeded in batch")
+        if not bool(jnp.all(jax.vmap(quiescent)(st))):
+            raise StallError("batch did not reach quiescence (livelock?)")
+        return self
+
+    def system_snapshots(self, b: int) -> List[NodeDump]:
+        st_b = jax.tree_util.tree_map(lambda x: np.asarray(x)[b], self.state)
+        arrs = JaxEngine._snap_arrays(st_b)
+        return [
+            _node_dump_from(arrs, i) for i in range(self.config.num_procs)
+        ]
+
+    @property
+    def instructions(self) -> int:
+        return int(jnp.sum(self.state.n_instr))

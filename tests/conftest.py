@@ -6,13 +6,31 @@ multi-chip path; bench runs on the real chip).
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+
+def pytest_configure(config):
+    # The axon sitecustomize registers the TPU PJRT plugin at
+    # interpreter startup and pins the backend, so an in-process
+    # JAX_PLATFORMS override is too late — re-exec once with a clean
+    # environment to get the virtual 8-device CPU mesh.  Capture must
+    # be released first or the child writes into pytest's temp file.
+    if os.environ.get("_HPA2_TEST_REEXEC") == "1":
+        return
+    env = dict(os.environ)
+    env["_HPA2_TEST_REEXEC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # disable axon TPU registration
+    xla_flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        env["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    args = list(config.invocation_params.args)
+    os.execvpe(sys.executable, [sys.executable, "-m", "pytest"] + args, env)
 
 import pathlib
 

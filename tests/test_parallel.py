@@ -1,0 +1,80 @@
+"""Multi-chip sharding: the node-sharded / grid engines must be
+bit-identical to the single-chip JAX engine (same cycles, counters,
+snapshots) — delivery order is preserved across the all_gather
+(ops/step.py phase C; SURVEY.md §2.4).
+
+Runs on the virtual 8-device CPU mesh from conftest.
+"""
+
+import jax
+import pytest
+
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.ops.engine import JaxEngine
+from hpa2_tpu.parallel import GridEngine, NodeShardedEngine, make_mesh
+from hpa2_tpu.utils.trace import (
+    gen_producer_consumer,
+    gen_uniform_random,
+    load_trace_dir,
+)
+
+ROBUST = Semantics().robust()
+
+
+def _require_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+
+
+def _assert_equal(sharded, ref):
+    assert sharded.cycle == ref.cycle
+    assert sharded.instructions == ref.instructions
+    assert sharded.messages == ref.messages
+    assert sharded.snapshots() == ref.snapshots()
+    assert sharded.final_dumps() == ref.final_dumps()
+
+
+@pytest.mark.parametrize("node_shards", [2, 4, 8])
+def test_node_sharded_matches_single_chip(node_shards):
+    _require_devices(node_shards)
+    cfg = SystemConfig(num_procs=8, semantics=ROBUST)
+    traces = gen_uniform_random(cfg, 40, seed=1)
+    ref = JaxEngine(cfg, traces).run()
+    eng = NodeShardedEngine(
+        cfg, traces, mesh=make_mesh(node_shards=node_shards)
+    ).run()
+    _assert_equal(eng, ref)
+
+
+def test_node_sharded_producer_consumer_16_nodes():
+    _require_devices(8)
+    cfg = SystemConfig(num_procs=16, semantics=ROBUST)
+    traces = gen_producer_consumer(cfg, 24, seed=3)
+    ref = JaxEngine(cfg, traces).run()
+    eng = NodeShardedEngine(
+        cfg, traces, mesh=make_mesh(node_shards=8)
+    ).run()
+    _assert_equal(eng, ref)
+
+
+def test_node_sharded_fixture_traces(reference_tests_dir):
+    """Deterministic suite (node-local traffic only) through the
+    sharded engine reproduces the single-chip snapshots."""
+    _require_devices(4)
+    cfg = SystemConfig()
+    traces = load_trace_dir(str(reference_tests_dir / "test_1"), cfg)
+    ref = JaxEngine(cfg, traces).run()
+    eng = NodeShardedEngine(
+        cfg, traces, mesh=make_mesh(node_shards=4)
+    ).run()
+    _assert_equal(eng, ref)
+
+
+def test_grid_matches_per_system():
+    _require_devices(8)
+    cfg = SystemConfig(num_procs=8, semantics=ROBUST)
+    batch = [gen_uniform_random(cfg, 30, seed=s) for s in range(4)]
+    grid = GridEngine(cfg, batch, mesh=make_mesh(node_shards=2)).run()
+    for b, traces in enumerate(batch):
+        ref = JaxEngine(cfg, traces).run()
+        assert grid.system_snapshots(b) == ref.snapshots()
