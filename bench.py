@@ -1,0 +1,104 @@
+"""Headline benchmark: simulated RD/WR ops/sec, JAX backend vs the
+native OpenMP free-running engine (the reference's execution model,
+assignment.c:135-137, rebuilt in native/).
+
+Workload (BASELINE.json configs 3+5): a vmapped ensemble of B=1024
+independent 8-node systems, uniform-random RD/WR traces, ~1M total
+instructions, run to quiescence entirely on device under one
+``lax.while_loop``.  Baseline: the C++/OpenMP engine on the same
+uniform-random workload shape (both sides report a rate, so the
+instruction volumes need not match).  Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from hpa2_tpu.config import Semantics, SystemConfig
+
+
+def bench_jax(config, batch, instrs_per_core, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    from hpa2_tpu.ops.engine import build_batched_run, stack_states
+    from hpa2_tpu.ops.state import init_state
+    from hpa2_tpu.utils.trace import gen_uniform_random
+
+    state = stack_states(
+        [
+            init_state(config, gen_uniform_random(config, instrs_per_core,
+                                                  seed=seed + b))
+            for b in range(batch)
+        ]
+    )
+    run = build_batched_run(config, max_cycles=1_000_000)
+
+    def once():
+        out = run(state)
+        return jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
+
+    once()  # compile warmup
+    t0 = time.perf_counter()
+    out = once()
+    dt = time.perf_counter() - t0
+    assert not bool(jnp.any(out.overflow)), "mailbox overflow"
+    from hpa2_tpu.ops.step import quiescent
+
+    assert bool(jnp.all(jax.vmap(quiescent)(out))), (
+        "batch hit max_cycles before quiescence; throughput would be "
+        "measured over a partial workload"
+    )
+    instrs = int(jnp.sum(out.n_instr))
+    return instrs, dt
+
+
+def bench_omp(config, instrs_per_core, seed=0):
+    from hpa2_tpu import native
+
+    res = native.bench_random(
+        config, instrs_per_core=instrs_per_core, seed=seed, mode="omp"
+    )
+    return int(res.instructions), float(res.seconds)
+
+
+def main():
+    config = SystemConfig(
+        num_procs=8, semantics=Semantics().robust()
+    )
+    batch, instrs_per_core = 1024, 128  # 1024*8*128 = 1,048,576 instrs
+
+    jax_instrs, jax_dt = bench_jax(config, batch, instrs_per_core)
+    jax_ops = jax_instrs / jax_dt
+
+    try:
+        omp_instrs, omp_dt = bench_omp(config, instrs_per_core=50_000)
+        omp_ops = omp_instrs / omp_dt
+    except Exception as e:  # baseline unavailable: report jax-only
+        print(json.dumps({
+            "metric": "sim_ops_per_sec_jax",
+            "value": round(jax_ops, 1),
+            "unit": "RD/WR ops/sec",
+            "vs_baseline": None,
+            "note": f"omp baseline failed: {e}",
+        }))
+        return 0
+
+    print(json.dumps({
+        "metric": "sim_ops_per_sec_jax",
+        "value": round(jax_ops, 1),
+        "unit": "RD/WR ops/sec",
+        "vs_baseline": round(jax_ops / omp_ops, 2),
+        "jax_instrs": jax_instrs,
+        "jax_seconds": round(jax_dt, 4),
+        "omp_ops_per_sec": round(omp_ops, 1),
+        "omp_instrs": omp_instrs,
+        "omp_seconds": round(omp_dt, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
