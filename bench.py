@@ -23,16 +23,13 @@ def bench_jax(config, batch, instrs_per_core, seed=0):
     import jax
     import jax.numpy as jnp
 
-    from hpa2_tpu.ops.engine import build_batched_run, stack_states
-    from hpa2_tpu.ops.state import init_state
-    from hpa2_tpu.utils.trace import gen_uniform_random
+    from hpa2_tpu.ops.engine import build_batched_run
+    from hpa2_tpu.ops.state import init_state_batched
+    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
 
-    state = stack_states(
-        [
-            init_state(config, gen_uniform_random(config, instrs_per_core,
-                                                  seed=seed + b))
-            for b in range(batch)
-        ]
+    state = init_state_batched(
+        config,
+        *gen_uniform_random_arrays(config, batch, instrs_per_core, seed=seed),
     )
     run = build_batched_run(config, max_cycles=1_000_000)
 

@@ -1,0 +1,5 @@
+import sys
+
+from hpa2_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,252 @@
+"""Command-line interface.
+
+The reference binary takes one positional arg (a directory under
+``tests/``, assignment.c:119-123) and writes ``core_<n>_output.txt``
+into the CWD (assignment.c:831).  This CLI keeps that I/O contract and
+adds what the reference hard-codes at compile time: backend selection,
+runtime geometry, semantics toggles, replay, and a synthetic benchmark
+mode (SURVEY.md §7.2 item 5).
+
+Examples::
+
+    python -m hpa2_tpu run tests/test_1 --backend jax
+    python -m hpa2_tpu run tests/test_3 --backend spec \
+        --replay tests/test_3/run_1/instruction_order.txt
+    python -m hpa2_tpu bench --backend jax --nodes 8 --instrs 1000 \
+        --batch 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from hpa2_tpu.config import Semantics, SystemConfig
+
+
+def _build_config(args) -> SystemConfig:
+    sem = Semantics()
+    if args.head_quirks:
+        sem = sem.head_quirks()
+    if args.robust:
+        sem = sem.robust()
+    return SystemConfig(
+        num_procs=args.nodes,
+        cache_size=args.cache_size,
+        mem_size=args.mem_size,
+        msg_buffer_size=args.msg_buffer_size,
+        max_instr_num=args.max_instr,
+        semantics=sem,
+    )
+
+
+def _write_dumps(dumps, config, out_dir: str) -> List[str]:
+    from hpa2_tpu.utils.dump import format_processor_state
+
+    paths = []
+    for d in dumps:
+        path = os.path.join(out_dir, f"core_{d.proc_id}_output.txt")
+        with open(path, "w") as fh:
+            fh.write(format_processor_state(d, config))
+        paths.append(path)
+    return paths
+
+
+def cmd_run(args) -> int:
+    config = _build_config(args)
+    out_dir = args.out or os.getcwd()
+    os.makedirs(out_dir, exist_ok=True)
+
+    if args.backend == "omp":
+        from hpa2_tpu import native
+
+        res = native.run_trace_dir(
+            config,
+            args.trace_dir,
+            out_dir,
+            mode="omp" if args.free_running else "lockstep",
+            replay_path=args.replay,
+            final_dump=args.final_dump,
+            max_cycles=args.max_cycles,
+        )
+        print(
+            f"[omp] {res.instructions} instrs, {res.messages} msgs, "
+            f"{res.seconds:.4f}s",
+            file=sys.stderr,
+        )
+        return 0
+
+    from hpa2_tpu.utils.trace import load_instruction_order, load_trace_dir
+
+    traces = load_trace_dir(args.trace_dir, config)
+    replay = load_instruction_order(args.replay) if args.replay else None
+
+    t0 = time.perf_counter()
+    if args.backend == "spec":
+        from hpa2_tpu.models.spec_engine import SpecEngine
+
+        eng = SpecEngine(config, traces, replay_order=replay)
+        eng.run(max_cycles=args.max_cycles)
+    else:
+        from hpa2_tpu.ops.engine import JaxEngine
+
+        eng = JaxEngine(
+            config, traces, replay_order=replay, max_cycles=args.max_cycles
+        )
+        eng.run()
+    dt = time.perf_counter() - t0
+
+    dumps = eng.final_dumps() if args.final_dump else eng.snapshots()
+    _write_dumps(dumps, config, out_dir)
+    print(
+        f"[{args.backend}] {eng.instructions} instrs, {eng.messages} msgs, "
+        f"{eng.cycle} cycles, {dt:.4f}s",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def cmd_bench(args) -> int:
+    config = _build_config(args)
+    from hpa2_tpu.utils.trace import (
+        gen_local_only,
+        gen_producer_consumer,
+        gen_uniform_random,
+    )
+
+    gen = {
+        "uniform": gen_uniform_random,
+        "producer-consumer": gen_producer_consumer,
+        "local": gen_local_only,
+    }[args.workload]
+
+    if args.backend == "omp":
+        from hpa2_tpu import native
+
+        res = native.bench_random(
+            config,
+            instrs_per_core=args.instrs,
+            seed=args.seed,
+            mode="omp" if args.free_running else "lockstep",
+        )
+        instrs, dt = int(res.instructions), float(res.seconds)
+    elif args.batch > 1:
+        import jax
+
+        from hpa2_tpu.ops.engine import BatchJaxEngine
+
+        batch_traces = [
+            gen(config, args.instrs, seed=args.seed + b)
+            for b in range(args.batch)
+        ]
+        eng = BatchJaxEngine(config, batch_traces, max_cycles=args.max_cycles)
+        eng.run()  # warmup/compile
+        eng2 = BatchJaxEngine(
+            config, batch_traces, max_cycles=args.max_cycles
+        )
+        t0 = time.perf_counter()
+        eng2.run()
+        dt = time.perf_counter() - t0
+        instrs = eng2.instructions
+    else:
+        from hpa2_tpu.ops.engine import JaxEngine
+
+        traces = gen(config, args.instrs, seed=args.seed)
+        JaxEngine(config, traces, max_cycles=args.max_cycles).run()
+        eng = JaxEngine(config, traces, max_cycles=args.max_cycles)
+        t0 = time.perf_counter()
+        eng.run()
+        dt = time.perf_counter() - t0
+        instrs = eng.instructions
+
+    print(
+        json.dumps(
+            {
+                "backend": args.backend,
+                "workload": args.workload,
+                "nodes": config.num_procs,
+                "batch": args.batch,
+                "instrs": instrs,
+                "seconds": round(dt, 4),
+                "ops_per_sec": round(instrs / dt, 1),
+            }
+        )
+    )
+    return 0
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--cache-size", type=int, default=4)
+    p.add_argument("--mem-size", type=int, default=16)
+    p.add_argument("--msg-buffer-size", type=int, default=256)
+    p.add_argument(
+        "--max-instr", type=int, default=32,
+        help="per-core trace cap (reference MAX_INSTR_NUM)",
+    )
+    p.add_argument("--max-cycles", type=int, default=1_000_000)
+    p.add_argument(
+        "--robust", action="store_true",
+        help="NACK/retry on stale interventions (sound at scale; "
+        "SURVEY.md §6.3)",
+    )
+    p.add_argument(
+        "--head-quirks", action="store_true",
+        help="emulate reference-HEAD divergences from its own fixtures "
+        "(SURVEY.md §6.2)",
+    )
+    p.add_argument(
+        "--free-running", action="store_true",
+        help="omp backend: thread-per-node free-running mode like the "
+        "reference (nondeterministic interleavings)",
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hpa2_tpu",
+        description="TPU-native directory-MESI DSM simulator",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    rp = sub.add_parser("run", help="run a trace directory, write dumps")
+    rp.add_argument("trace_dir")
+    rp.add_argument(
+        "--backend", choices=("spec", "jax", "omp"), default="jax"
+    )
+    rp.add_argument("--out", help="output directory (default: CWD)")
+    rp.add_argument(
+        "--replay", help="instruction_order.txt to replay", default=None
+    )
+    rp.add_argument(
+        "--final-dump", action="store_true",
+        help="dump final quiescent state instead of at local completion",
+    )
+    _add_common(rp)
+    rp.set_defaults(fn=cmd_run)
+
+    bp = sub.add_parser("bench", help="synthetic benchmark, JSON result")
+    bp.add_argument(
+        "--backend", choices=("jax", "omp"), default="jax"
+    )
+    bp.add_argument(
+        "--workload",
+        choices=("uniform", "producer-consumer", "local"),
+        default="uniform",
+    )
+    bp.add_argument("--instrs", type=int, default=1000)
+    bp.add_argument("--batch", type=int, default=1)
+    bp.add_argument("--seed", type=int, default=0)
+    _add_common(bp)
+    bp.set_defaults(fn=cmd_bench)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -158,6 +158,14 @@ class SpecEngine:
         self.counters: Dict[str, int] = collections.defaultdict(int)
         self.max_mailbox_depth = 0
 
+    @property
+    def instructions(self) -> int:
+        return self.counters["instructions"]
+
+    @property
+    def messages(self) -> int:
+        return self.counters["msgs_total"]
+
     # -- transport ----------------------------------------------------
 
     def _send(self, phase: int, receiver: int, msg: Message) -> None:

@@ -25,6 +25,22 @@ from hpa2_tpu.utils.trace import IssueRecord
 I32 = jnp.int32
 U32 = jnp.uint32
 
+# mb_data column layout
+MB_TYPE, MB_SENDER, MB_ADDR, MB_VALUE, MB_SECOND, MB_SHARERS = 0, 1, 2, 3, 4, 5
+
+
+def _mb_empty_row(w: int) -> np.ndarray:
+    """Packed empty-slot sentinel (type=-1, second=-1)."""
+    return np.array([-1, 0, 0, 0, -1] + [0] * w, dtype=np.int32)
+
+
+def _mem_init(n: int, m: int) -> np.ndarray:
+    """Reference memory init ``(20*id + i) mod 256`` (assignment.c:779)."""
+    return np.array(
+        [[(20 * i + j) % 256 for j in range(m)] for i in range(n)],
+        dtype=np.int32,
+    )
+
 
 class SimState(NamedTuple):
     """One simulated system (no batch axis; vmap adds it)."""
@@ -37,14 +53,11 @@ class SimState(NamedTuple):
     mem: jnp.ndarray
     dir_state: jnp.ndarray
     dir_sharers: jnp.ndarray  # [N, M, W] uint32
-    # mailboxes [N, cap]
-    mb_type: jnp.ndarray
-    mb_sender: jnp.ndarray
-    mb_addr: jnp.ndarray
-    mb_value: jnp.ndarray
-    mb_sharers: jnp.ndarray  # [N, cap, W] uint32
-    mb_second: jnp.ndarray
-    mb_head: jnp.ndarray  # [N]
+    # mailboxes: shift-down FIFO queues, head always at slot 0 (reads
+    # are static slices; no gather — TPU scalarizes fused gathers).
+    # One packed [N, cap, F] int32 array, columns = MB_* below
+    # (sharer words bitcast to int32).
+    mb_data: jnp.ndarray  # [N, cap, 5 + W]
     mb_count: jnp.ndarray  # [N]
     # core state [N]
     pc: jnp.ndarray
@@ -72,6 +85,71 @@ class SimState(NamedTuple):
     n_instr: jnp.ndarray
     n_msgs: jnp.ndarray
     overflow: jnp.ndarray  # bool: a mailbox exceeded capacity
+
+
+def init_state_batched(
+    config: SystemConfig,
+    tr_op: np.ndarray,
+    tr_addr: np.ndarray,
+    tr_val: np.ndarray,
+    tr_len: np.ndarray,
+) -> SimState:
+    """Batched initial state straight from trace arrays.
+
+    ``tr_op/tr_addr/tr_val`` are ``[B, N, T]`` (op: 0=RD, 1=WR, -1=pad),
+    ``tr_len`` is ``[B, N]``.  Equivalent to ``stack_states([init_state(
+    config, traces_b) for b ...])`` but without the per-system Python
+    loops — the only viable construction path for large ensembles.
+    """
+    b, n, t = tr_op.shape
+    c, m, w = config.cache_size, config.mem_size, config.sharer_words
+    cap = config.msg_buffer_size
+    if n != config.num_procs:
+        raise ValueError(f"trace node axis {n} != num_procs {config.num_procs}")
+    for name, arr in (("tr_addr", tr_addr), ("tr_val", tr_val)):
+        if arr.shape != (b, n, t):
+            raise ValueError(f"{name} shape {arr.shape} != {(b, n, t)}")
+    if tr_len.shape != (b, n):
+        raise ValueError(f"tr_len shape {tr_len.shape} != {(b, n)}")
+    if np.any(tr_len < 0) or np.any(tr_len > t):
+        raise ValueError(f"tr_len out of range 0..{t}")
+
+    mem0 = np.broadcast_to(_mem_init(n, m), (b, n, m))
+    full = lambda shape, val, dt: jnp.full(shape, val, dtype=dt)
+    zeros = lambda shape, dt: jnp.zeros(shape, dtype=dt)
+    return SimState(
+        cache_addr=full((b, n, c), INVALID_ADDR, I32),
+        cache_val=zeros((b, n, c), I32),
+        cache_state=full((b, n, c), int(CacheState.INVALID), I32),
+        mem=jnp.asarray(mem0),
+        dir_state=full((b, n, m), int(DirState.U), I32),
+        dir_sharers=zeros((b, n, m, w), U32),
+        mb_data=jnp.broadcast_to(
+            jnp.asarray(_mb_empty_row(w)), (b, n, cap, 5 + w)
+        ),
+        mb_count=zeros((b, n), I32),
+        pc=zeros((b, n), I32),
+        waiting=zeros((b, n), bool),
+        pending_write=zeros((b, n), I32),
+        tr_op=jnp.asarray(tr_op, dtype=I32),
+        tr_addr=jnp.asarray(tr_addr, dtype=I32),
+        tr_val=jnp.asarray(tr_val, dtype=I32),
+        tr_len=jnp.asarray(tr_len, dtype=I32),
+        order_node=full((b, 1), -1, I32),
+        order_pos=zeros((b,), I32),
+        order_len=full((b,), -1, I32),
+        snap_taken=zeros((b, n), bool),
+        snap_mem=jnp.asarray(mem0),
+        snap_dir_state=full((b, n, m), int(DirState.U), I32),
+        snap_dir_sharers=zeros((b, n, m, w), U32),
+        snap_cache_addr=full((b, n, c), INVALID_ADDR, I32),
+        snap_cache_val=zeros((b, n, c), I32),
+        snap_cache_state=full((b, n, c), int(CacheState.INVALID), I32),
+        cycle=zeros((b,), I32),
+        n_instr=zeros((b,), I32),
+        n_msgs=zeros((b,), I32),
+        overflow=zeros((b,), bool),
+    )
 
 
 def init_state(
@@ -114,10 +192,7 @@ def init_state(
         order_node = np.array([-1], dtype=np.int32)
         order_len = np.int32(-1)  # -1 = free-run
 
-    mem0 = np.array(
-        [[(20 * i + j) % 256 for j in range(m)] for i in range(n)],
-        dtype=np.int32,
-    )
+    mem0 = _mem_init(n, m)
 
     return SimState(
         cache_addr=jnp.full((n, c), INVALID_ADDR, dtype=I32),
@@ -126,13 +201,9 @@ def init_state(
         mem=jnp.asarray(mem0),
         dir_state=jnp.full((n, m), int(DirState.U), dtype=I32),
         dir_sharers=jnp.zeros((n, m, w), dtype=U32),
-        mb_type=jnp.full((n, cap), -1, dtype=I32),
-        mb_sender=jnp.zeros((n, cap), dtype=I32),
-        mb_addr=jnp.zeros((n, cap), dtype=I32),
-        mb_value=jnp.zeros((n, cap), dtype=I32),
-        mb_sharers=jnp.zeros((n, cap, w), dtype=U32),
-        mb_second=jnp.full((n, cap), -1, dtype=I32),
-        mb_head=jnp.zeros((n,), dtype=I32),
+        mb_data=jnp.broadcast_to(
+            jnp.asarray(_mb_empty_row(w)), (n, cap, 5 + w)
+        ),
         mb_count=jnp.zeros((n,), dtype=I32),
         pc=jnp.zeros((n,), dtype=I32),
         waiting=jnp.zeros((n,), dtype=bool),

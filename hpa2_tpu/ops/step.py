@@ -48,7 +48,15 @@ import jax.numpy as jnp
 from hpa2_tpu.config import SystemConfig
 from hpa2_tpu.models.protocol import CacheState, DirState, MsgType
 from hpa2_tpu.ops import bits
-from hpa2_tpu.ops.state import SimState
+from hpa2_tpu.ops.state import (
+    MB_ADDR,
+    MB_SECOND,
+    MB_SENDER,
+    MB_SHARERS,
+    MB_TYPE,
+    MB_VALUE,
+    SimState,
+)
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -68,13 +76,36 @@ _NO_MSG = -1
 
 
 def _gather_n(arr, idx):
-    """arr [N, K], idx [N] -> [N] (one element per row)."""
-    return jnp.take_along_axis(arr, idx[:, None], axis=1)[:, 0]
+    """arr [N, K], idx [N] -> [N] (one element per row).
+
+    One-hot masked reduction rather than take_along_axis: TPU
+    scalarizes gathers fused into larger computations (measured
+    ~100x slower than this dense form for the small K used here).
+    """
+    k = arr.shape[1]
+    hot = jnp.arange(k, dtype=I32)[None, :] == idx[:, None]
+    return jnp.sum(jnp.where(hot, arr, arr.dtype.type(0)), axis=1)
 
 
 def _gather_nw(arr, idx):
     """arr [N, K, W], idx [N] -> [N, W]."""
-    return jnp.take_along_axis(arr, idx[:, None, None], axis=1)[:, 0, :]
+    k = arr.shape[1]
+    hot = jnp.arange(k, dtype=I32)[None, :] == idx[:, None]
+    return jnp.sum(
+        jnp.where(hot[:, :, None], arr, arr.dtype.type(0)), axis=1
+    )
+
+
+# above ~this K the one-hot mask streams more HBM than the scalarized
+# gather costs; long-trace fetches switch back to take_along_axis
+_ONEHOT_MAX_K = 512
+
+
+def _fetch_n(arr, idx):
+    """_gather_n that stays O(N) for large trailing axes (traces)."""
+    if arr.shape[1] <= _ONEHOT_MAX_K:
+        return _gather_n(arr, idx)
+    return jnp.take_along_axis(arr, idx[:, None], axis=1)[:, 0]
 
 
 class _SendSlots:
@@ -178,16 +209,24 @@ def build_step(
                 + local_ids
             )
         # ============== phase A: handle one message per node ==========
+        # head is always slot 0 (shift-down queue): reads are static
+        # slices — a fused gather would be scalarized by the TPU
+        # backend (measured ~1000x slower than this formulation)
         has_msg = st.mb_count > 0
-        head = st.mb_head
-        mt = jnp.where(has_msg, _gather_n(st.mb_type, head), _NO_MSG)
-        snd = _gather_n(st.mb_sender, head)
-        a = jnp.maximum(_gather_n(st.mb_addr, head), 0)
-        v = _gather_n(st.mb_value, head)
-        msh = _gather_nw(st.mb_sharers, head)
-        sr = _gather_n(st.mb_second, head)
+        hm = st.mb_data[:, 0, :]
+        mt = jnp.where(has_msg, hm[:, MB_TYPE], _NO_MSG)
+        snd = hm[:, MB_SENDER]
+        a = jnp.maximum(hm[:, MB_ADDR], 0)
+        v = hm[:, MB_VALUE]
+        msh = jax.lax.bitcast_convert_type(hm[:, MB_SHARERS:], U32)
+        sr = hm[:, MB_SECOND]
 
-        mb_head2 = jnp.where(has_msg, (head + 1) % cap, head)
+        # consume the head: shift the queue down one slot
+        qdata = jnp.where(
+            has_msg[:, None, None],
+            jnp.roll(st.mb_data, -1, axis=1),
+            st.mb_data,
+        )
         mb_count2 = st.mb_count - has_msg.astype(I32)
 
         home = a // m
@@ -475,9 +514,9 @@ def build_step(
             elig = elig & (node_ids == cur) & (st.order_pos < st.order_len)
 
         pcc = jnp.minimum(st.pc, st.tr_op.shape[1] - 1)
-        op = _gather_n(st.tr_op, pcc)
-        ia = _gather_n(st.tr_addr, pcc)
-        iv = _gather_n(st.tr_val, pcc)
+        op = _fetch_n(st.tr_op, pcc)
+        ia = _fetch_n(st.tr_addr, pcc)
+        iv = _fetch_n(st.tr_val, pcc)
         ci2 = ia % c
         home2 = ia // m
 
@@ -602,28 +641,47 @@ def build_step(
         ) | inv_hit
 
         offs = jnp.cumsum(valid_rj.astype(I32), axis=1) - valid_rj.astype(I32)
-        pos = (mb_head2[:, None] + mb_count2[:, None] + offs) % cap
-        # out-of-range index for invalid candidates -> dropped
-        pos = jnp.where(valid_rj, pos, cap)
-
-        r_idx = jnp.broadcast_to(local_ids[:, None], (n_local, j))
-
-        def scatter(buf, vals):
-            return buf.at[r_idx, pos].set(
-                jnp.broadcast_to(vals[None, :], (n_local, j)), mode="drop"
-            )
-
-        mb_type = scatter(st.mb_type, f["type"])
-        mb_sender = scatter(st.mb_sender, f["sender"])
-        mb_addr = scatter(st.mb_addr, f["addr"])
-        mb_value = scatter(st.mb_value, f["value"])
-        mb_second = scatter(st.mb_second, f["second"])
-        mb_sharers = st.mb_sharers.at[r_idx, pos].set(
-            jnp.broadcast_to(f["sharers"][None, :, :], (n_local, j, w)),
-            mode="drop",
-        )
-
         delivered = jnp.sum(valid_rj.astype(I32), axis=1)
+
+        # TPU gathers/scatters fused into this graph get scalarized
+        # (measured ms-scale); deliver instead by one-hot placement:
+        # candidate j lands at queue slot count2 + offs — a dense
+        # [N, cap, J] mask reduced against the packed field matrix.
+        # Exact in int32: at most one candidate is hot per (node, slot).
+        sh_i32 = jax.lax.bitcast_convert_type(f["sharers"], I32)  # [J, w]
+        fmat = jnp.concatenate(
+            [f["type"][:, None], f["sender"][:, None], f["addr"][:, None],
+             f["value"][:, None], f["second"][:, None], sh_i32],
+            axis=1,
+        )  # [J, F]
+        pos = mb_count2[:, None] + offs                       # [N, J]
+        slot = jnp.arange(cap, dtype=I32)
+        hot = valid_rj[:, None, :] & (pos[:, None, :] == slot[None, :, None])
+        # lower the placement to an MXU matmul: split each int32 field
+        # into 4 byte planes (exact in bf16 — every product is
+        # one-hot x byte, and at most one candidate is hot per slot so
+        # sums have at most one nonzero term), multiply, recombine.
+        fm_u = jax.lax.bitcast_convert_type(fmat, U32)        # [J, F]
+        planes = jnp.concatenate(
+            [((fm_u >> (8 * p)) & U32(0xFF)) for p in range(4)], axis=1
+        ).astype(jnp.bfloat16)                                # [J, 4F]
+        pl = jnp.einsum(
+            "ncj,jf->ncf",
+            hot.astype(jnp.bfloat16),
+            planes,
+            preferred_element_type=jnp.float32,
+        ).astype(U32)                                         # [N, cap, 4F]
+        nf = fmat.shape[1]
+        placed_u = (
+            pl[..., 0 * nf : 1 * nf]
+            | (pl[..., 1 * nf : 2 * nf] << 8)
+            | (pl[..., 2 * nf : 3 * nf] << 16)
+            | (pl[..., 3 * nf : 4 * nf] << 24)
+        )
+        placed = jax.lax.bitcast_convert_type(placed_u, I32)  # [N, cap, F]
+        krel = slot[None, :] - mb_count2[:, None]
+        write = (krel >= 0) & (krel < delivered[:, None])
+        mb_data = jnp.where(write[:, :, None], placed, qdata)
         mb_count3 = mb_count2 + delivered
         ov_now = jnp.any(mb_count3 > cap)
         instr_inc = jnp.sum(elig.astype(I32))
@@ -654,13 +712,7 @@ def build_step(
             mem=mem,
             dir_state=dir_state,
             dir_sharers=dir_sharers,
-            mb_type=mb_type,
-            mb_sender=mb_sender,
-            mb_addr=mb_addr,
-            mb_value=mb_value,
-            mb_sharers=mb_sharers,
-            mb_second=mb_second,
-            mb_head=mb_head2,
+            mb_data=mb_data,
             mb_count=mb_count3,
             pc=pc,
             waiting=waiting,

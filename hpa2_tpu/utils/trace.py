@@ -229,3 +229,30 @@ def gen_local_only(
             ]
         )
     return traces
+
+
+def gen_uniform_random_arrays(
+    config: SystemConfig,
+    batch: int,
+    instrs_per_core: int,
+    seed: int = 0,
+    write_frac: float = 0.5,
+):
+    """Vectorized batched uniform-random workload as ``[B, N, T]``
+    numpy arrays (op 0=RD/1=WR, addr, value) + ``[B, N]`` lengths —
+    the input format of ``ops.state.init_state_batched`` (building
+    large ensembles through per-instruction Python objects is orders
+    of magnitude too slow)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    shape = (batch, config.num_procs, instrs_per_core)
+    op = (rng.random(shape) < write_frac).astype(np.int32)
+    addr = rng.integers(
+        0, config.num_addresses, shape, dtype=np.int32
+    )
+    val = rng.integers(0, 256, shape, dtype=np.int32)
+    length = np.full(
+        (batch, config.num_procs), instrs_per_core, dtype=np.int32
+    )
+    return op, addr, val, length
