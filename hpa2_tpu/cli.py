@@ -31,6 +31,12 @@ from hpa2_tpu.config import Semantics, SystemConfig
 def _build_config(args) -> SystemConfig:
     sem = Semantics()
     if args.head_quirks:
+        if getattr(args, "backend", "spec") != "spec":
+            raise SystemExit(
+                "--head-quirks is only implemented by the spec engine "
+                "(use --backend spec); the jax/omp backends run fixture "
+                "semantics (SURVEY.md §6.2)"
+            )
         sem = sem.head_quirks()
     if args.robust:
         sem = sem.robust()
@@ -125,6 +131,11 @@ def cmd_bench(args) -> int:
     }[args.workload]
 
     if args.backend == "omp":
+        if args.workload != "uniform" or args.batch > 1:
+            raise SystemExit(
+                "the omp backend benchmarks the uniform workload at "
+                "batch 1 only (native trace generation)"
+            )
         from hpa2_tpu import native
 
         res = native.bench_random(
@@ -136,22 +147,39 @@ def cmd_bench(args) -> int:
         instrs, dt = int(res.instructions), float(res.seconds)
     elif args.batch > 1:
         import jax
+        import jax.numpy as jnp
 
-        from hpa2_tpu.ops.engine import BatchJaxEngine
+        from hpa2_tpu.models.spec_engine import StallError
+        from hpa2_tpu.ops.engine import build_batched_run, stack_states
+        from hpa2_tpu.ops.state import init_state, init_state_batched
+        from hpa2_tpu.ops.step import quiescent
+        from hpa2_tpu.utils.trace import gen_uniform_random_arrays
 
-        batch_traces = [
-            gen(config, args.instrs, seed=args.seed + b)
-            for b in range(args.batch)
-        ]
-        eng = BatchJaxEngine(config, batch_traces, max_cycles=args.max_cycles)
-        eng.run()  # warmup/compile
-        eng2 = BatchJaxEngine(
-            config, batch_traces, max_cycles=args.max_cycles
-        )
+        if args.workload == "uniform":
+            state = init_state_batched(
+                config,
+                *gen_uniform_random_arrays(
+                    config, args.batch, args.instrs, seed=args.seed
+                ),
+            )
+        else:
+            state = stack_states(
+                [
+                    init_state(config, gen(config, args.instrs,
+                                           seed=args.seed + b))
+                    for b in range(args.batch)
+                ]
+            )
+        run = build_batched_run(config, max_cycles=args.max_cycles)
+        jax.block_until_ready(run(state))  # warmup/compile
         t0 = time.perf_counter()
-        eng2.run()
+        out = jax.block_until_ready(run(state))
         dt = time.perf_counter() - t0
-        instrs = eng2.instructions
+        if bool(jnp.any(out.overflow)) or not bool(
+            jnp.all(jax.vmap(quiescent)(out))
+        ):
+            raise StallError("batch did not reach quiescence")
+        instrs = int(jnp.sum(out.n_instr))
     else:
         from hpa2_tpu.ops.engine import JaxEngine
 

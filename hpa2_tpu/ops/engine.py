@@ -173,6 +173,34 @@ class JaxEngine:
     def messages(self) -> int:
         return int(self.state.n_msgs)
 
+    def stats(self) -> dict:
+        """Counter dict with the spec engine's key names (the
+        reference has no observability at all — SURVEY.md §5)."""
+        return engine_stats(self.state)
+
+
+def engine_stats(st: SimState) -> dict:
+    from hpa2_tpu.models.protocol import MsgType
+
+    mc = np.asarray(st.msg_counts)
+    if mc.ndim == 2:  # batched state: aggregate over the ensemble
+        mc = mc.sum(axis=0)
+    tot = lambda x: int(np.sum(np.asarray(x)))
+    out = {
+        "instructions": tot(st.n_instr),
+        "msgs_total": tot(st.n_msgs),
+        "read_hits": tot(st.n_read_hits),
+        "read_misses": tot(st.n_read_miss),
+        "write_hits": tot(st.n_write_hits),
+        "write_misses": tot(st.n_write_miss),
+        "evictions": tot(st.n_evictions),
+        "invalidations": tot(st.n_invalidations),
+    }
+    for t in MsgType:
+        if mc[int(t)]:
+            out[f"msg_{t.name}"] = int(mc[int(t)])
+    return out
+
 
 # ---------------------------------------------------------------------------
 # Batched ensembles: B independent systems advanced by one vmapped step

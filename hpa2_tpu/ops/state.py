@@ -19,7 +19,13 @@ import numpy as np
 import jax.numpy as jnp
 
 from hpa2_tpu.config import SystemConfig
-from hpa2_tpu.models.protocol import Instr, INVALID_ADDR, CacheState, DirState
+from hpa2_tpu.models.protocol import (
+    Instr,
+    INVALID_ADDR,
+    CacheState,
+    DirState,
+    MsgType,
+)
 from hpa2_tpu.utils.trace import IssueRecord
 
 I32 = jnp.int32
@@ -85,6 +91,15 @@ class SimState(NamedTuple):
     n_instr: jnp.ndarray
     n_msgs: jnp.ndarray
     overflow: jnp.ndarray  # bool: a mailbox exceeded capacity
+    # observability counters (the reference has none — SURVEY.md §5);
+    # names/semantics match spec_engine.counters for differential tests
+    n_read_hits: jnp.ndarray
+    n_read_miss: jnp.ndarray
+    n_write_hits: jnp.ndarray
+    n_write_miss: jnp.ndarray
+    n_evictions: jnp.ndarray
+    n_invalidations: jnp.ndarray
+    msg_counts: jnp.ndarray  # [len(MsgType)] sends by transaction type
 
 
 def init_state_batched(
@@ -149,6 +164,13 @@ def init_state_batched(
         n_instr=zeros((b,), I32),
         n_msgs=zeros((b,), I32),
         overflow=zeros((b,), bool),
+        n_read_hits=zeros((b,), I32),
+        n_read_miss=zeros((b,), I32),
+        n_write_hits=zeros((b,), I32),
+        n_write_miss=zeros((b,), I32),
+        n_evictions=zeros((b,), I32),
+        n_invalidations=zeros((b,), I32),
+        msg_counts=zeros((b, len(MsgType)), I32),
     )
 
 
@@ -226,4 +248,11 @@ def init_state(
         n_instr=jnp.zeros((), dtype=I32),
         n_msgs=jnp.zeros((), dtype=I32),
         overflow=jnp.zeros((), dtype=bool),
+        n_read_hits=jnp.zeros((), dtype=I32),
+        n_read_miss=jnp.zeros((), dtype=I32),
+        n_write_hits=jnp.zeros((), dtype=I32),
+        n_write_miss=jnp.zeros((), dtype=I32),
+        n_evictions=jnp.zeros((), dtype=I32),
+        n_invalidations=jnp.zeros((), dtype=I32),
+        msg_counts=jnp.zeros((len(MsgType),), dtype=I32),
     )

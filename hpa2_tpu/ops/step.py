@@ -299,7 +299,7 @@ def build_step(
         # --- REPLY_RD (assignment.c:238-247) -------------------------
         mk = typ(MsgType.REPLY_RD)
         ev = mk & ~line_match
-        _evict_msg(sA0, ev, line_addr, line_val, line_state, m)
+        ev_replyrd = _evict_msg(sA0, ev, line_addr, line_val, line_state, m)
         upd_line = upd_line | mk
         nl_addr = jnp.where(mk, a, nl_addr)
         nl_val = jnp.where(mk, v, nl_val)
@@ -331,7 +331,7 @@ def build_step(
         mem_val = jnp.where(mk & is_home, v, mem_val)
         rq = mk & is_second
         ev = rq & ~line_match
-        _evict_msg(sA0, ev, line_addr, line_val, line_state, m)
+        ev_flush = _evict_msg(sA0, ev, line_addr, line_val, line_state, m)
         upd_line = upd_line | rq
         nl_addr = jnp.where(rq, a, nl_addr)
         nl_val = jnp.where(rq, v, nl_val)
@@ -367,9 +367,11 @@ def build_step(
 
         # --- INV (assignment.c:366-373) ------------------------------
         mk = typ(MsgType.INV)
-        hit = mk & line_match & ((line_state == _S) | (line_state == _E))
-        upd_line = upd_line | hit
-        nl_state = jnp.where(hit, _I, nl_state)
+        inv_applied = mk & line_match & (
+            (line_state == _S) | (line_state == _E)
+        )
+        upd_line = upd_line | inv_applied
+        nl_state = jnp.where(inv_applied, _I, nl_state)
 
         # --- WRITE_REQUEST (home only; assignment.c:375-435) ---------
         mk = typ(MsgType.WRITE_REQUEST) & is_home
@@ -532,7 +534,7 @@ def build_step(
 
         rm = is_rd & ~hit
         wm = is_wr & ~hit
-        _evict_msg(sB0, rm | wm, l2_addr, l2_val, l2_state, m)
+        ev_issue = _evict_msg(sB0, rm | wm, l2_addr, l2_val, l2_state, m)
         sB1.put(rm, recv=home2, type_=int(MsgType.READ_REQUEST), addr=ia)
         sB1.put(
             wm, recv=home2, type_=int(MsgType.WRITE_REQUEST), addr=ia,
@@ -686,11 +688,38 @@ def build_step(
         ov_now = jnp.any(mb_count3 > cap)
         instr_inc = jnp.sum(elig.astype(I32))
         msgs_inc = jnp.sum(delivered)
+        # observability counters (names match spec_engine.counters)
+        cnt = lambda mask: jnp.sum(mask.astype(I32))
+        rd_hit_inc = cnt(is_rd & hit)
+        rd_miss_inc = cnt(rm)
+        wr_hit_inc = cnt(is_wr & hit)
+        wr_miss_inc = cnt(wm)
+        ev_inc = cnt(ev_replyrd | ev_flush | ev_issue)
+        inv_inc = cnt(inv_applied)
+        # sends by transaction type: fan-out count per candidate
+        # (receivers holding it valid), bucketed by the type column
+        cand_cnt = jnp.sum(valid_rj.astype(I32), axis=0)  # [J]
+        type_ids = jnp.arange(len(MsgType), dtype=I32)
+        mc_inc = jnp.sum(
+            jnp.where(
+                f["type"][None, :] == type_ids[:, None],
+                cand_cnt[None, :],
+                0,
+            ),
+            axis=1,
+        )  # [len(MsgType)]
         if axis_name is not None:
             # replicate the global counters so out_specs stay P()
             ov_now = jax.lax.psum(ov_now.astype(I32), axis_name) > 0
             instr_inc = jax.lax.psum(instr_inc, axis_name)
             msgs_inc = jax.lax.psum(msgs_inc, axis_name)
+            rd_hit_inc = jax.lax.psum(rd_hit_inc, axis_name)
+            rd_miss_inc = jax.lax.psum(rd_miss_inc, axis_name)
+            wr_hit_inc = jax.lax.psum(wr_hit_inc, axis_name)
+            wr_miss_inc = jax.lax.psum(wr_miss_inc, axis_name)
+            ev_inc = jax.lax.psum(ev_inc, axis_name)
+            inv_inc = jax.lax.psum(inv_inc, axis_name)
+            mc_inc = jax.lax.psum(mc_inc, axis_name)
         overflow = st.overflow | ov_now
 
         # ============== phase D: dump-at-local-completion =============
@@ -735,6 +764,13 @@ def build_step(
             n_instr=st.n_instr + instr_inc,
             n_msgs=st.n_msgs + msgs_inc,
             overflow=overflow,
+            n_read_hits=st.n_read_hits + rd_hit_inc,
+            n_read_miss=st.n_read_miss + rd_miss_inc,
+            n_write_hits=st.n_write_hits + wr_hit_inc,
+            n_write_miss=st.n_write_miss + wr_miss_inc,
+            n_evictions=st.n_evictions + ev_inc,
+            n_invalidations=st.n_invalidations + inv_inc,
+            msg_counts=st.msg_counts + mc_inc,
         )
 
     return step

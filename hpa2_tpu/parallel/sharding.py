@@ -46,7 +46,10 @@ _NODE_LEADING = frozenset(
     f
     for f in SimState._fields
     if f not in ("order_node", "order_pos", "order_len",
-                 "cycle", "n_instr", "n_msgs", "overflow")
+                 "cycle", "n_instr", "n_msgs", "overflow",
+                 "n_read_hits", "n_read_miss", "n_write_hits",
+                 "n_write_miss", "n_evictions", "n_invalidations",
+                 "msg_counts")
 )
 
 

@@ -1,0 +1,59 @@
+"""CLI layer (SURVEY.md §7.2 item 5): reference I/O contract — one
+trace dir in, ``core_<n>_output.txt`` out (assignment.c:119-123, 831) —
+plus backend selection, replay, and the bench subcommand."""
+
+import json
+import pathlib
+
+import pytest
+
+from hpa2_tpu.cli import main
+
+REF = pathlib.Path("/root/reference/tests")
+
+
+@pytest.mark.parametrize("backend", ["spec", "jax"])
+def test_run_matches_fixtures(tmp_path, backend, reference_tests_dir):
+    rc = main([
+        "run", str(reference_tests_dir / "test_1"),
+        "--backend", backend, "--out", str(tmp_path),
+    ])
+    assert rc == 0
+    for i in range(4):
+        got = (tmp_path / f"core_{i}_output.txt").read_text()
+        want = (reference_tests_dir / "test_1" / f"core_{i}_output.txt").read_text()
+        assert got == want
+
+
+def test_run_replay(tmp_path, reference_tests_dir):
+    suite = reference_tests_dir / "test_3"
+    rc = main([
+        "run", str(suite), "--backend", "spec",
+        "--replay", str(suite / "run_1" / "instruction_order.txt"),
+        "--out", str(tmp_path),
+    ])
+    assert rc == 0
+    assert (tmp_path / "core_0_output.txt").exists()
+
+
+def test_bench_json(tmp_path, capsys):
+    rc = main([
+        "bench", "--backend", "jax", "--nodes", "4", "--instrs", "16",
+        "--batch", "2", "--robust", "--max-instr", "0",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["instrs"] == 4 * 16 * 2
+    assert out["ops_per_sec"] > 0
+
+
+def test_run_omp_backend(tmp_path, reference_tests_dir):
+    rc = main([
+        "run", str(reference_tests_dir / "test_2"),
+        "--backend", "omp", "--out", str(tmp_path),
+    ])
+    assert rc == 0
+    for i in range(4):
+        got = (tmp_path / f"core_{i}_output.txt").read_text()
+        want = (reference_tests_dir / "test_2" / f"core_{i}_output.txt").read_text()
+        assert got == want
