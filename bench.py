@@ -1,12 +1,13 @@
-"""Headline benchmark: simulated RD/WR ops/sec, JAX backend vs the
+"""Headline benchmark: simulated RD/WR ops/sec, TPU backends vs the
 native OpenMP free-running engine (the reference's execution model,
 assignment.c:135-137, rebuilt in native/).
 
-Workload (BASELINE.json configs 3+5): a vmapped ensemble of B=1024
-independent 8-node systems, uniform-random RD/WR traces, ~1M total
-instructions, run to quiescence entirely on device under one
-``lax.while_loop``.  Baseline: the C++/OpenMP engine on the same
-uniform-random workload shape (both sides report a rate, so the
+Workload (BASELINE.json configs 3+5): an ensemble of independent
+8-node systems, uniform-random RD/WR traces, run to quiescence on one
+chip.  Primary engine: the VMEM-resident Pallas kernel
+(ops/pallas_engine.py); falls back to the XLA ``lax.while_loop``
+engine if the kernel path fails.  Baseline: the C++/OpenMP engine on
+the same uniform-random workload shape (both sides report a rate, so
 instruction volumes need not match).  Prints ONE JSON line.
 """
 
@@ -17,6 +18,20 @@ import sys
 import time
 
 from hpa2_tpu.config import Semantics, SystemConfig
+
+
+def bench_pallas(config, batch, instrs_per_core, seed=0):
+    from hpa2_tpu.ops.pallas_engine import PallasEngine
+    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+    arrays = gen_uniform_random_arrays(config, batch, instrs_per_core,
+                                       seed=seed)
+    PallasEngine(config, *arrays).run()  # compile + warmup
+    eng = PallasEngine(config, *arrays)
+    t0 = time.perf_counter()
+    eng.run()
+    dt = time.perf_counter() - t0
+    return eng.instructions, dt
 
 
 def bench_jax(config, batch, instrs_per_core, seed=0):
@@ -63,11 +78,26 @@ def bench_omp(config, instrs_per_core, seed=0):
 
 def main():
     config = SystemConfig(
-        num_procs=8, semantics=Semantics().robust()
+        num_procs=8, msg_buffer_size=32, semantics=Semantics().robust()
     )
-    batch, instrs_per_core = 1024, 128  # 1024*8*128 = 1,048,576 instrs
+    import jax
 
-    jax_instrs, jax_dt = bench_jax(config, batch, instrs_per_core)
+    on_tpu = any("tpu" in str(d).lower() for d in jax.devices())
+    if on_tpu:
+        batch, instrs_per_core = 8192, 128  # 8.4M instrs
+    else:  # CPU smoke (pallas runs interpreted): keep it tiny
+        batch, instrs_per_core = 8, 16
+
+    engine = "pallas"
+    try:
+        jax_instrs, jax_dt = bench_pallas(config, batch, instrs_per_core)
+    except Exception as e:
+        print(f"pallas path failed ({e}); falling back to XLA engine",
+              file=sys.stderr)
+        engine = "xla"
+        if on_tpu:
+            batch = 1024
+        jax_instrs, jax_dt = bench_jax(config, batch, instrs_per_core)
     jax_ops = jax_instrs / jax_dt
 
     try:
@@ -88,6 +118,7 @@ def main():
         "value": round(jax_ops, 1),
         "unit": "RD/WR ops/sec",
         "vs_baseline": round(jax_ops / omp_ops, 2),
+        "engine": engine,
         "jax_instrs": jax_instrs,
         "jax_seconds": round(jax_dt, 4),
         "omp_ops_per_sec": round(omp_ops, 1),

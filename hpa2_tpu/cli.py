@@ -145,31 +145,57 @@ def cmd_bench(args) -> int:
             mode="omp" if args.free_running else "lockstep",
         )
         instrs, dt = int(res.instructions), float(res.seconds)
+    elif args.backend == "pallas":
+        from hpa2_tpu.ops.pallas_engine import PallasEngine
+        from hpa2_tpu.utils.trace import (
+            gen_uniform_random_arrays,
+            traces_to_arrays,
+        )
+
+        if args.workload == "uniform":
+            arrays = gen_uniform_random_arrays(
+                config, args.batch, args.instrs, seed=args.seed
+            )
+        else:
+            arrays = traces_to_arrays(
+                config,
+                [
+                    gen(config, args.instrs, seed=args.seed + b)
+                    for b in range(args.batch)
+                ],
+            )
+        PallasEngine(config, *arrays).run(args.max_cycles)  # warmup
+        eng = PallasEngine(config, *arrays)
+        t0 = time.perf_counter()
+        eng.run(args.max_cycles)
+        dt = time.perf_counter() - t0
+        instrs = eng.instructions
     elif args.batch > 1:
         import jax
         import jax.numpy as jnp
 
         from hpa2_tpu.models.spec_engine import StallError
-        from hpa2_tpu.ops.engine import build_batched_run, stack_states
-        from hpa2_tpu.ops.state import init_state, init_state_batched
+        from hpa2_tpu.ops.engine import build_batched_run
+        from hpa2_tpu.ops.state import init_state_batched
         from hpa2_tpu.ops.step import quiescent
-        from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+        from hpa2_tpu.utils.trace import (
+            gen_uniform_random_arrays,
+            traces_to_arrays,
+        )
 
         if args.workload == "uniform":
-            state = init_state_batched(
-                config,
-                *gen_uniform_random_arrays(
-                    config, args.batch, args.instrs, seed=args.seed
-                ),
+            arrays = gen_uniform_random_arrays(
+                config, args.batch, args.instrs, seed=args.seed
             )
         else:
-            state = stack_states(
+            arrays = traces_to_arrays(
+                config,
                 [
-                    init_state(config, gen(config, args.instrs,
-                                           seed=args.seed + b))
+                    gen(config, args.instrs, seed=args.seed + b)
                     for b in range(args.batch)
-                ]
+                ],
             )
+        state = init_state_batched(config, *arrays)
         run = build_batched_run(config, max_cycles=args.max_cycles)
         jax.block_until_ready(run(state))  # warmup/compile
         t0 = time.perf_counter()
@@ -259,7 +285,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     bp = sub.add_parser("bench", help="synthetic benchmark, JSON result")
     bp.add_argument(
-        "--backend", choices=("jax", "omp"), default="jax"
+        "--backend", choices=("jax", "pallas", "omp"), default="jax"
     )
     bp.add_argument(
         "--workload",

@@ -179,27 +179,36 @@ class JaxEngine:
         return engine_stats(self.state)
 
 
-def engine_stats(st: SimState) -> dict:
+def format_stats(core: dict, msg_counts) -> dict:
+    """Shared counter-dict shape (spec-engine key names) for all
+    engines — the single place the naming lives."""
     from hpa2_tpu.models.protocol import MsgType
 
+    out = dict(core)
+    for t in MsgType:
+        if msg_counts[int(t)]:
+            out[f"msg_{t.name}"] = int(msg_counts[int(t)])
+    return out
+
+
+def engine_stats(st: SimState) -> dict:
     mc = np.asarray(st.msg_counts)
     if mc.ndim == 2:  # batched state: aggregate over the ensemble
         mc = mc.sum(axis=0)
     tot = lambda x: int(np.sum(np.asarray(x)))
-    out = {
-        "instructions": tot(st.n_instr),
-        "msgs_total": tot(st.n_msgs),
-        "read_hits": tot(st.n_read_hits),
-        "read_misses": tot(st.n_read_miss),
-        "write_hits": tot(st.n_write_hits),
-        "write_misses": tot(st.n_write_miss),
-        "evictions": tot(st.n_evictions),
-        "invalidations": tot(st.n_invalidations),
-    }
-    for t in MsgType:
-        if mc[int(t)]:
-            out[f"msg_{t.name}"] = int(mc[int(t)])
-    return out
+    return format_stats(
+        {
+            "instructions": tot(st.n_instr),
+            "msgs_total": tot(st.n_msgs),
+            "read_hits": tot(st.n_read_hits),
+            "read_misses": tot(st.n_read_miss),
+            "write_hits": tot(st.n_write_hits),
+            "write_misses": tot(st.n_write_miss),
+            "evictions": tot(st.n_evictions),
+            "invalidations": tot(st.n_invalidations),
+        },
+        mc,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,881 @@
+"""VMEM-resident Pallas engine: the TPU-native fast path.
+
+The XLA ``lax.while_loop`` engine (ops/step.py) round-trips the whole
+simulator state through HBM every cycle — the measured per-cycle floor
+is HBM traffic + fusion overhead.  This engine runs ``K`` lockstep
+cycles per ``pallas_call`` with all state resident in VMEM, so HBM is
+touched once per K cycles instead of twice per cycle.
+
+Layout: every array carries the ensemble axis **last** so it maps onto
+TPU vector lanes (blocks of ``BB`` systems per grid step), and the
+per-system structure (nodes, cache/memory/queue slots) lives in
+sublanes:
+
+    cache_*   [N, C, B]      mem/dir_* [N, M, B]
+    mb        [N, F, cap, B] (packed message fields, head at slot 0)
+    tr_*      [N, T, B]      scalars/counters [SC, B] rows
+
+Semantics are *identical* to ops/step.py (fixture semantics + optional
+NACK robustness, SURVEY.md §6.2/§6.3): the cycle body below is a
+re-lowering of the same spec — phase A handle-one-message, phase B
+issue, phase C deterministic delivery in (phase, sender, slot) order,
+phase D dump-at-local-completion snapshots.  Differential tests gate
+it against the spec engine and the XLA engine.
+
+Restrictions: ``num_procs <= 32`` (single sharer word), no replay mode
+(fixture replays run on the XLA/spec engines), ``5 * num_procs`` send
+candidates must fit the mailbox capacity check as usual.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hpa2_tpu.config import SystemConfig
+from hpa2_tpu.models.protocol import CacheState, DirState, MsgType
+from hpa2_tpu.models.spec_engine import StallError
+from hpa2_tpu.utils.dump import NodeDump
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+_M = int(CacheState.MODIFIED)
+_E = int(CacheState.EXCLUSIVE)
+_S = int(CacheState.SHARED)
+_I = int(CacheState.INVALID)
+_EM = int(DirState.EM)
+_DS = int(DirState.S)
+_DU = int(DirState.U)
+
+_NO_MSG = -1
+_INVALID_ADDR = -1
+
+# packed mailbox field rows (mb[:, row, slot, :])
+_F_TYPE, _F_SENDER, _F_ADDR, _F_VALUE, _F_SECOND, _F_SHARERS = range(6)
+_NFIELD = 6
+
+# scalar counter rows (scalars[row, :])
+(_SC_CYCLE, _SC_INSTR, _SC_MSGS, _SC_OVERFLOW, _SC_RH, _SC_RM,
+ _SC_WH, _SC_WM, _SC_EV, _SC_INV) = range(10)
+_NSCALAR = 10
+
+_NTYPES = len(MsgType)
+
+#: carried state field names, in kernel argument order
+STATE_FIELDS = (
+    "cache_addr", "cache_val", "cache_state",
+    "mem", "dir_state", "dir_sharers",
+    "mb", "mb_count", "pc", "waiting", "pending_write",
+    "snap_taken", "snap_mem", "snap_dir_state", "snap_dir_sharers",
+    "snap_cache_addr", "snap_cache_val", "snap_cache_state",
+    "scalars", "msg_counts",
+)
+TRACE_FIELDS = ("tr_op", "tr_addr", "tr_val", "tr_len")
+
+
+def _popcount(x):
+    """popcount on int32 bit patterns (SWAR; Mosaic-safe)."""
+    u = x.astype(U32)
+    u = u - ((u >> 1) & U32(0x55555555))
+    u = (u & U32(0x33333333)) + ((u >> 2) & U32(0x33333333))
+    u = (u + (u >> 4)) & U32(0x0F0F0F0F)
+    return ((u * U32(0x01010101)) >> 24).astype(I32)
+
+
+def _find_owner(x):
+    """Lowest set bit index of an int32 mask; -1 when empty
+    (reference findOwner, assignment.c:98-105)."""
+    u = x.astype(U32)
+    lsb = u & (U32(0) - u)
+    pos = _popcount((lsb - U32(1)).astype(I32))
+    return jnp.where(u == 0, I32(-1), pos)
+
+
+def _bit(proc):
+    """One-hot int32 mask for node id(s); negative -> 0."""
+    p = jnp.clip(proc, 0, 31)
+    return jnp.where(proc >= 0, I32(1) << p, I32(0))
+
+
+def _test_bit(mask, proc):
+    return (mask >> jnp.clip(proc, 0, 31)) & 1 == 1
+
+
+def build_cycle(config: SystemConfig, bb: int):
+    """One lockstep cycle over a block of ``bb`` systems in transposed
+    layout.  Pure jnp on a state dict — runs inside the Pallas kernel
+    and, for validation, directly under jit/CPU."""
+    n, c, m = config.num_procs, config.cache_size, config.mem_size
+    cap = config.msg_buffer_size
+    sem = config.semantics
+    if n > 32:
+        raise ValueError("pallas engine supports num_procs <= 32")
+    if sem.overloaded_evict_shared_notify:
+        raise ValueError("pallas engine implements fixture semantics only")
+    nack = sem.intervention_miss_policy == "nack"
+
+    def cycle(s: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        s = dict(s)
+        # iotas are built inside the traced body (a pallas kernel may
+        # not capture array constants from the closure)
+        iota_n = jax.lax.broadcasted_iota(I32, (n, bb), 0)
+        iota_c = jax.lax.broadcasted_iota(I32, (n, c, bb), 1)
+        iota_m = jax.lax.broadcasted_iota(I32, (n, m, bb), 1)
+        iota_cap = jax.lax.broadcasted_iota(I32, (n, cap, bb), 1)
+        iota_t = jax.lax.broadcasted_iota(I32, (_NTYPES, bb), 0)
+
+        def read_c(arr, idx):  # [N,C,B] by [N,B] -> [N,B]
+            return jnp.sum(
+                jnp.where(iota_c == idx[:, None, :], arr, 0), axis=1
+            )
+
+        def read_m(arr, idx):
+            return jnp.sum(
+                jnp.where(iota_m == idx[:, None, :], arr, 0), axis=1
+            )
+
+        def write_c(arr, idx, mask, val):
+            hot = (iota_c == idx[:, None, :]) & mask[:, None, :]
+            return jnp.where(hot, val[:, None, :], arr)
+
+        def write_m(arr, idx, mask, val):
+            hot = (iota_m == idx[:, None, :]) & mask[:, None, :]
+            return jnp.where(hot, val[:, None, :], arr)
+        # ===== phase A: handle one message per node ==================
+        has_msg = s["mb_count"] > 0
+        head = s["mb"][:, :, 0, :]                       # [N, F, B]
+        mt = jnp.where(has_msg, head[:, _F_TYPE, :], _NO_MSG)
+        snd = head[:, _F_SENDER, :]
+        a = jnp.maximum(head[:, _F_ADDR, :], 0)
+        v = head[:, _F_VALUE, :]
+        sr = head[:, _F_SECOND, :]
+        msh = head[:, _F_SHARERS, :]
+
+        rolled = jnp.concatenate(
+            [s["mb"][:, :, 1:, :], s["mb"][:, :, :1, :]], axis=2
+        )
+        qdata = jnp.where(has_msg[:, None, None, :], rolled, s["mb"])
+        count2 = s["mb_count"] - has_msg.astype(I32)
+
+        home = a // m
+        blk = a % m
+        ci = a % c
+        is_home = iota_n == home
+        is_second = iota_n == sr
+
+        line_addr = read_c(s["cache_addr"], ci)
+        line_val = read_c(s["cache_val"], ci)
+        line_state = read_c(s["cache_state"], ci)
+        ds = read_m(s["dir_state"], blk)
+        dsh = read_m(s["dir_sharers"], blk)
+        mem_blk = read_m(s["mem"], blk)
+        pw = s["pending_write"]
+
+        line_match = line_addr == a
+        line_me = (line_state == _M) | (line_state == _E)
+        owner = _find_owner(dsh)
+        owner_is_snd = owner == snd
+        snd_bit = _bit(snd)
+
+        zero = jnp.zeros((n, bb), dtype=I32)
+        false = jnp.zeros((n, bb), dtype=bool)
+
+        def slot():
+            return {
+                "valid": false, "recv": zero, "type": zero, "addr": zero,
+                "value": zero, "second": jnp.full((n, bb), -1, I32),
+                "sharers": zero,
+            }
+
+        def put(sl, mask, recv, type_, addr, value=None, sharers=None,
+                second=None):
+            sl["valid"] = sl["valid"] | mask
+            sl["recv"] = jnp.where(mask, recv, sl["recv"])
+            sl["type"] = jnp.where(mask, type_, sl["type"])
+            sl["addr"] = jnp.where(mask, addr, sl["addr"])
+            if value is not None:
+                sl["value"] = jnp.where(mask, value, sl["value"])
+            if sharers is not None:
+                sl["sharers"] = jnp.where(mask, sharers, sl["sharers"])
+            if second is not None:
+                sl["second"] = jnp.where(mask, second, sl["second"])
+
+        def evict_msg(sl, mask, l_addr, l_val, l_state):
+            """handleCacheReplacement (assignment.c:742-773)."""
+            vv = mask & (l_addr != _INVALID_ADDR) & (l_state != _I)
+            put(
+                sl, vv,
+                recv=jnp.maximum(l_addr, 0) // m,
+                type_=jnp.where(
+                    l_state == _M,
+                    int(MsgType.EVICT_MODIFIED),
+                    int(MsgType.EVICT_SHARED),
+                ),
+                addr=l_addr,
+                value=l_val,
+            )
+            return vv
+
+        sA0, sA1 = slot(), slot()
+        inv_sharers = zero
+        inv_addr = zero
+
+        nl_addr, nl_val, nl_state = line_addr, line_val, line_state
+        upd_line = false
+        nd_state, nd_sharers = ds, dsh
+        upd_dir = false
+        mem_write = false
+        mem_val = mem_blk
+        waiting = s["waiting"] != 0
+
+        def typ(t):
+            return mt == int(t)
+
+        # --- READ_REQUEST (assignment.c:188-236) ---------------------
+        mk = typ(MsgType.READ_REQUEST) & is_home
+        du, dss, dem = ds == _DU, ds == _DS, ds == _EM
+        reply_mask = mk & (du | dss | (dem & owner_is_snd))
+        excl = du | (dem & owner_is_snd)
+        put(sA0, reply_mask, recv=snd, type_=int(MsgType.REPLY_RD),
+            addr=a, value=mem_blk,
+            sharers=jnp.where(excl, I32(2), I32(0)))
+        fwd = mk & dem & ~owner_is_snd
+        put(sA0, fwd, recv=owner, type_=int(MsgType.WRITEBACK_INT),
+            addr=a, second=snd)
+        upd_dir = upd_dir | (mk & (du | dss | fwd))
+        nd_state = jnp.where(mk & du, _EM, nd_state)
+        nd_state = jnp.where(fwd, _DS, nd_state)
+        nd_sharers = jnp.where(mk & du, snd_bit, nd_sharers)
+        nd_sharers = jnp.where(
+            mk & (dss | fwd), nd_sharers | snd_bit, nd_sharers
+        )
+
+        # --- REPLY_RD (assignment.c:238-247) -------------------------
+        mk = typ(MsgType.REPLY_RD)
+        ev_replyrd = evict_msg(
+            sA0, mk & ~line_match, line_addr, line_val, line_state
+        )
+        upd_line = upd_line | mk
+        nl_addr = jnp.where(mk, a, nl_addr)
+        nl_val = jnp.where(mk, v, nl_val)
+        nl_state = jnp.where(mk, jnp.where(msh == 2, _E, _S), nl_state)
+        waiting = jnp.where(mk, False, waiting)
+
+        # --- WRITEBACK_INT (assignment.c:249-271) --------------------
+        mk = typ(MsgType.WRITEBACK_INT)
+        ok = mk & line_match & line_me
+        put(sA0, ok, recv=home, type_=int(MsgType.FLUSH), addr=a,
+            value=line_val, second=sr)
+        put(sA1, ok & (sr != home), recv=sr, type_=int(MsgType.FLUSH),
+            addr=a, value=line_val, second=sr)
+        upd_line = upd_line | ok
+        nl_state = jnp.where(ok, _S, nl_state)
+        if nack:
+            put(sA0, mk & ~(line_match & line_me), recv=home,
+                type_=int(MsgType.NACK), addr=a, second=sr)
+
+        # --- FLUSH (assignment.c:273-296) ----------------------------
+        mk = typ(MsgType.FLUSH)
+        mem_write = mem_write | (mk & is_home)
+        mem_val = jnp.where(mk & is_home, v, mem_val)
+        rq = mk & is_second
+        ev_flush = evict_msg(
+            sA0, rq & ~line_match, line_addr, line_val, line_state
+        )
+        upd_line = upd_line | rq
+        nl_addr = jnp.where(rq, a, nl_addr)
+        nl_val = jnp.where(rq, v, nl_val)
+        nl_state = jnp.where(rq, _S, nl_state)
+        waiting = jnp.where(rq, False, waiting)
+
+        # --- UPGRADE (assignment.c:298-328) --------------------------
+        mk = typ(MsgType.UPGRADE) & is_home
+        reply_sh = jnp.where(mk & (ds == _DS), dsh & ~snd_bit, 0)
+        put(sA0, mk, recv=snd, type_=int(MsgType.REPLY_ID), addr=a,
+            sharers=reply_sh)
+        upd_dir = upd_dir | mk
+        nd_state = jnp.where(mk, _EM, nd_state)
+        nd_sharers = jnp.where(mk, snd_bit, nd_sharers)
+
+        # --- REPLY_ID (assignment.c:330-364) -------------------------
+        mk = typ(MsgType.REPLY_ID)
+        fill = mk & line_match & (line_state != _M)
+        upd_line = upd_line | fill
+        nl_val = jnp.where(fill, pw, nl_val)
+        nl_state = jnp.where(fill, _M, nl_state)
+        fan = mk & line_match
+        inv_sharers = jnp.where(fan, msh & ~_bit(iota_n), inv_sharers)
+        inv_addr = jnp.where(fan, a, inv_addr)
+        waiting = jnp.where(mk, False, waiting)
+
+        # --- INV (assignment.c:366-373) ------------------------------
+        mk = typ(MsgType.INV)
+        inv_applied = mk & line_match & (
+            (line_state == _S) | (line_state == _E)
+        )
+        upd_line = upd_line | inv_applied
+        nl_state = jnp.where(inv_applied, _I, nl_state)
+
+        # --- WRITE_REQUEST (assignment.c:375-435) --------------------
+        mk = typ(MsgType.WRITE_REQUEST) & is_home
+        if sem.eager_write_request_memory:
+            mem_write = mem_write | mk
+            mem_val = jnp.where(mk, v, mem_val)
+        du, dss, dem = ds == _DU, ds == _DS, ds == _EM
+        put(sA0, mk & (du | (dem & owner_is_snd)), recv=snd,
+            type_=int(MsgType.REPLY_WR), addr=a)
+        put(sA0, mk & dss, recv=snd, type_=int(MsgType.REPLY_ID),
+            addr=a, sharers=dsh & ~snd_bit)
+        wr_fwd = mk & dem & ~owner_is_snd
+        put(sA0, wr_fwd, recv=owner, type_=int(MsgType.WRITEBACK_INV),
+            addr=a, second=snd)
+        upd_dir = upd_dir | (mk & (du | dss | wr_fwd))
+        nd_state = jnp.where(mk & (du | dss), _EM, nd_state)
+        nd_sharers = jnp.where(mk & (du | dss | wr_fwd), snd_bit, nd_sharers)
+
+        # --- REPLY_WR (assignment.c:437-449) -------------------------
+        mk = typ(MsgType.REPLY_WR)
+        upd_line = upd_line | mk
+        nl_addr = jnp.where(mk, a, nl_addr)
+        nl_val = jnp.where(mk, pw, nl_val)
+        nl_state = jnp.where(mk, _M, nl_state)
+        waiting = jnp.where(mk, False, waiting)
+
+        # --- WRITEBACK_INV (assignment.c:451-473) --------------------
+        mk = typ(MsgType.WRITEBACK_INV)
+        ok = mk & line_match & line_me
+        put(sA0, ok, recv=home, type_=int(MsgType.FLUSH_INVACK),
+            addr=a, value=line_val, second=sr)
+        put(sA1, ok & (sr != home), recv=sr,
+            type_=int(MsgType.FLUSH_INVACK), addr=a, value=line_val,
+            second=sr)
+        upd_line = upd_line | ok
+        nl_state = jnp.where(ok, _I, nl_state)
+        if nack:
+            put(sA0, mk & ~(line_match & line_me), recv=home,
+                type_=int(MsgType.NACK), addr=a, sharers=jnp.full_like(zero, 1),
+                second=sr)
+
+        # --- FLUSH_INVACK (assignment.c:475-496) ---------------------
+        mk = typ(MsgType.FLUSH_INVACK)
+        hm = mk & is_home
+        mem_write = mem_write | hm
+        mem_val = jnp.where(hm, v, mem_val)
+        upd_dir = upd_dir | hm
+        nd_state = jnp.where(hm, _EM, nd_state)
+        nd_sharers = jnp.where(hm, _bit(sr), nd_sharers)
+        rq = mk & is_second
+        upd_line = upd_line | rq
+        nl_addr = jnp.where(rq, a, nl_addr)
+        nl_val = jnp.where(
+            rq, v if sem.flush_invack_fills_old_value else pw, nl_val
+        )
+        nl_state = jnp.where(rq, _M, nl_state)
+        waiting = jnp.where(rq, False, waiting)
+
+        # --- EVICT_SHARED home role (assignment.c:498-521) -----------
+        mk = typ(MsgType.EVICT_SHARED) & is_home & _test_bit(dsh, snd)
+        after = dsh & ~snd_bit
+        cnt = _popcount(after)
+        upd_dir = upd_dir | mk
+        nd_sharers = jnp.where(mk, after, nd_sharers)
+        nd_state = jnp.where(mk & (cnt == 0), _DU, nd_state)
+        upg = mk & (cnt == 1) & (ds == _DS)
+        nd_state = jnp.where(upg, _EM, nd_state)
+        put(sA0, upg, recv=_find_owner(after),
+            type_=int(MsgType.UPGRADE_NOTIFY), addr=a)
+
+        # --- UPGRADE_NOTIFY (fixture semantics; spec_engine) ---------
+        mk = typ(MsgType.UPGRADE_NOTIFY) & (snd == home)
+        hit_un = mk & line_match & (line_state == _S)
+        upd_line = upd_line | hit_un
+        nl_state = jnp.where(hit_un, _E, nl_state)
+
+        # --- EVICT_MODIFIED (assignment.c:541-561) -------------------
+        mk = typ(MsgType.EVICT_MODIFIED) & is_home
+        mem_write = mem_write | mk
+        mem_val = jnp.where(mk, v, mem_val)
+        drop = mk & (ds == _EM) & _test_bit(dsh, snd)
+        upd_dir = upd_dir | drop
+        nd_state = jnp.where(drop, _DU, nd_state)
+        nd_sharers = jnp.where(drop, 0, nd_sharers)
+
+        # --- NACK re-serve (robust mode; spec_engine) ----------------
+        if nack:
+            mk = typ(MsgType.NACK) & is_home
+            rd = mk & (msh == 0)
+            wr = mk & (msh != 0)
+            sr_bit = _bit(sr)
+            upd_dir = upd_dir | mk
+            nd_state = jnp.where(rd, _DS, nd_state)
+            nd_state = jnp.where(wr, _EM, nd_state)
+            nd_sharers = jnp.where(rd, nd_sharers | sr_bit, nd_sharers)
+            nd_sharers = jnp.where(wr, sr_bit, nd_sharers)
+            put(sA0, rd, recv=sr, type_=int(MsgType.REPLY_RD), addr=a,
+                value=mem_blk)
+            put(sA0, wr, recv=sr, type_=int(MsgType.REPLY_WR), addr=a)
+
+        # apply phase-A updates
+        cache_addr = write_c(s["cache_addr"], ci, upd_line, nl_addr)
+        cache_val = write_c(s["cache_val"], ci, upd_line, nl_val)
+        cache_state = write_c(s["cache_state"], ci, upd_line, nl_state)
+        dir_state = write_m(s["dir_state"], blk, upd_dir, nd_state)
+        dir_sharers = write_m(s["dir_sharers"], blk, upd_dir, nd_sharers)
+        mem = write_m(s["mem"], blk, mem_write, mem_val)
+
+        # ===== phase B: instruction issue ============================
+        tr_len = s["tr_len"]
+        elig = (count2 == 0) & ~waiting & (s["pc"] < tr_len)
+        t_dim = s["tr_op"].shape[1]
+        pcc = jnp.minimum(s["pc"], t_dim - 1)
+        iota_tr = jax.lax.broadcasted_iota(I32, (n, t_dim, bb), 1)
+        hot_tr = iota_tr == pcc[:, None, :]
+        fetch = lambda arr: jnp.sum(jnp.where(hot_tr, arr, 0), axis=1)
+        op = fetch(s["tr_op"])
+        ia = fetch(s["tr_addr"])
+        iv = fetch(s["tr_val"])
+        ci2 = ia % c
+        home2 = ia // m
+
+        l2_addr = read_c(cache_addr, ci2)
+        l2_val = read_c(cache_val, ci2)
+        l2_state = read_c(cache_state, ci2)
+        hit = (l2_addr == ia) & (l2_state != _I)
+        is_rd = elig & (op == 0)
+        is_wr = elig & (op == 1)
+
+        sB0, sB1 = slot(), slot()
+        rm = is_rd & ~hit
+        wm = is_wr & ~hit
+        ev_issue = evict_msg(sB0, rm | wm, l2_addr, l2_val, l2_state)
+        put(sB1, rm, recv=home2, type_=int(MsgType.READ_REQUEST), addr=ia)
+        put(sB1, wm, recv=home2, type_=int(MsgType.WRITE_REQUEST),
+            addr=ia, value=iv)
+        wh_me = is_wr & hit & ((l2_state == _M) | (l2_state == _E))
+        wh_s = is_wr & hit & (l2_state == _S)
+        put(sB1, wh_s, recv=home2, type_=int(MsgType.UPGRADE), addr=ia)
+
+        pending_write = jnp.where(is_wr, iv, s["pending_write"])
+        waiting = waiting | rm | wm | wh_s
+
+        i_upd = rm | wm | wh_me | wh_s
+        n2_addr = jnp.where(rm | wm, ia, l2_addr)
+        n2_val = jnp.where(rm | wm, 0, jnp.where(wh_me | wh_s, iv, l2_val))
+        n2_state = jnp.where(
+            rm | wm, _I, jnp.where(wh_me | wh_s, _M, l2_state)
+        )
+        cache_addr = write_c(cache_addr, ci2, i_upd, n2_addr)
+        cache_val = write_c(cache_val, ci2, i_upd, n2_val)
+        cache_state = write_c(cache_state, ci2, i_upd, n2_state)
+        pc = s["pc"] + elig.astype(I32)
+
+        # ===== phase C: deterministic delivery =======================
+        # candidate order matches ops/step.py exactly: phase A sends
+        # sender-major over slots [sA0, sA1, inv], then phase B over
+        # [sB0, sB1] (assignment.c:711-739's locked enqueue becomes a
+        # fixed traversal)
+        mb = qdata
+        acc = zero  # running enqueue offset per receiver
+        msgs_delivered = jnp.zeros((1, bb), dtype=I32)
+        mc_inc = jnp.zeros((_NTYPES, bb), dtype=I32)
+
+        def deliver(mb, acc, md, mc, valid_nb, type_v, fields):
+            """Enqueue one candidate: fields are [B] rows in mb-row
+            order (type, sender, addr, value, second, sharers)."""
+            pos = count2 + acc
+            hot = (iota_cap == pos[:, None, :]) & valid_nb[:, None, :]
+            planes = []
+            for frow in range(_NFIELD):
+                planes.append(
+                    jnp.where(hot, fields[frow][None, None, :],
+                              mb[:, frow, :, :])
+                )
+            mb = jnp.stack(planes, axis=1)
+            dcount = jnp.sum(valid_nb.astype(I32), axis=0, keepdims=True)
+            md = md + dcount
+            mc = mc + jnp.where(iota_t == type_v[None, :], dcount, 0)
+            return mb, acc + valid_nb.astype(I32), md, mc
+
+        def point_candidate(mb, acc, md, mc, sl, sender):
+            valid_s = sl["valid"][sender]                  # [B]
+            recv_s = sl["recv"][sender]
+            valid_nb = valid_s[None, :] & (iota_n == recv_s[None, :])
+            type_v = sl["type"][sender]
+            fields = [
+                type_v,
+                jnp.full((bb,), sender, I32),
+                sl["addr"][sender],
+                sl["value"][sender],
+                sl["second"][sender],
+                sl["sharers"][sender],
+            ]
+            return deliver(mb, acc, md, mc, valid_nb, type_v, fields)
+
+        def inv_candidate(mb, acc, md, mc, sender):
+            mask_s = inv_sharers[sender]                   # [B]
+            valid_nb = ((mask_s[None, :] >> iota_n) & 1) == 1
+            type_v = jnp.full((bb,), int(MsgType.INV), I32)
+            fields = [
+                type_v,
+                jnp.full((bb,), sender, I32),
+                inv_addr[sender],
+                jnp.zeros((bb,), I32),
+                jnp.full((bb,), -1, I32),
+                jnp.zeros((bb,), I32),
+            ]
+            return deliver(mb, acc, md, mc, valid_nb, type_v, fields)
+
+        md = msgs_delivered
+        mc = mc_inc
+        for sender in range(n):
+            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sA0, sender)
+            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sA1, sender)
+            mb, acc, md, mc = inv_candidate(mb, acc, md, mc, sender)
+        for sender in range(n):
+            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sB0, sender)
+            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sB1, sender)
+
+        mb_count3 = count2 + acc
+        overflow_now = jnp.any(mb_count3 > cap, axis=0, keepdims=True)
+
+        # ===== phase D: dump-at-local-completion snapshots ===========
+        done_node = (pc >= tr_len) & ~waiting & (mb_count3 == 0)
+        snap_now = done_node & ~(s["snap_taken"] != 0)
+        s2 = snap_now[:, None, :]
+        snap_mem = jnp.where(s2, mem, s["snap_mem"])
+        snap_dir_state = jnp.where(s2, dir_state, s["snap_dir_state"])
+        snap_dir_sharers = jnp.where(s2, dir_sharers, s["snap_dir_sharers"])
+        snap_cache_addr = jnp.where(s2, cache_addr, s["snap_cache_addr"])
+        snap_cache_val = jnp.where(s2, cache_val, s["snap_cache_val"])
+        snap_cache_state = jnp.where(s2, cache_state, s["snap_cache_state"])
+
+        # ===== counters ==============================================
+        row = lambda x: jnp.sum(x.astype(I32), axis=0, keepdims=True)
+        sc = s["scalars"]
+        upd = [
+            (_SC_CYCLE, jnp.ones((1, bb), I32)),
+            (_SC_INSTR, row(elig)),
+            (_SC_MSGS, md),
+            (_SC_OVERFLOW, overflow_now.astype(I32)),
+            (_SC_RH, row(is_rd & hit)),
+            (_SC_RM, row(rm)),
+            (_SC_WH, row(is_wr & hit)),
+            (_SC_WM, row(wm)),
+            (_SC_EV, row(ev_replyrd | ev_flush | ev_issue)),
+            (_SC_INV, row(inv_applied)),
+        ]
+        iota_sc = jax.lax.broadcasted_iota(I32, (_NSCALAR, bb), 0)
+        inc = jnp.zeros((_NSCALAR, bb), I32)
+        for rid, val in upd:
+            inc = jnp.where(iota_sc == rid, val, inc)
+        # overflow row is sticky-OR, everything else accumulates
+        sc = jnp.where(
+            iota_sc == _SC_OVERFLOW, jnp.maximum(sc, inc), sc + inc
+        )
+
+        return {
+            "cache_addr": cache_addr, "cache_val": cache_val,
+            "cache_state": cache_state, "mem": mem,
+            "dir_state": dir_state, "dir_sharers": dir_sharers,
+            "mb": mb, "mb_count": mb_count3, "pc": pc,
+            "waiting": waiting.astype(I32),
+            "pending_write": pending_write,
+            "snap_taken": ((s["snap_taken"] != 0) | done_node).astype(I32),
+            "snap_mem": snap_mem, "snap_dir_state": snap_dir_state,
+            "snap_dir_sharers": snap_dir_sharers,
+            "snap_cache_addr": snap_cache_addr,
+            "snap_cache_val": snap_cache_val,
+            "snap_cache_state": snap_cache_state,
+            "scalars": sc, "msg_counts": s["msg_counts"] + mc,
+            "tr_op": s["tr_op"], "tr_addr": s["tr_addr"],
+            "tr_val": s["tr_val"], "tr_len": s["tr_len"],
+        }
+
+    return cycle
+
+
+def quiescent_block(s) -> jnp.ndarray:
+    """[B] bool: per-system quiescence in transposed layout."""
+    return (
+        jnp.all(s["pc"] >= s["tr_len"], axis=0)
+        & jnp.all(s["waiting"] == 0, axis=0)
+        & jnp.all(s["mb_count"] == 0, axis=0)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Kernel wrapper + host runner
+# ---------------------------------------------------------------------------
+
+def _init_transposed(config: SystemConfig, tr_op, tr_addr, tr_val, tr_len):
+    """Initial state dict in transposed layout from [B, N, T] traces
+    (initializeProcessor semantics, assignment.c:776-822)."""
+    b, n, t = tr_op.shape
+    c, m, cap = config.cache_size, config.mem_size, config.msg_buffer_size
+    mem0 = np.broadcast_to(
+        np.array(
+            [[(20 * i + j) % 256 for j in range(m)] for i in range(n)],
+            dtype=np.int32,
+        )[:, :, None],
+        (n, m, b),
+    )
+    mb0 = np.zeros((n, _NFIELD, cap, b), dtype=np.int32)
+    mb0[:, _F_TYPE] = -1
+    mb0[:, _F_SECOND] = -1
+    z2 = np.zeros((n, b), dtype=np.int32)
+    state = {
+        "cache_addr": np.full((n, c, b), _INVALID_ADDR, np.int32),
+        "cache_val": np.zeros((n, c, b), np.int32),
+        "cache_state": np.full((n, c, b), _I, np.int32),
+        "mem": mem0.copy(),
+        "dir_state": np.full((n, m, b), _DU, np.int32),
+        "dir_sharers": np.zeros((n, m, b), np.int32),
+        "mb": mb0,
+        "mb_count": z2.copy(), "pc": z2.copy(),
+        "waiting": z2.copy(), "pending_write": z2.copy(),
+        "snap_taken": z2.copy(),
+        "snap_mem": mem0.copy(),
+        "snap_dir_state": np.full((n, m, b), _DU, np.int32),
+        "snap_dir_sharers": np.zeros((n, m, b), np.int32),
+        "snap_cache_addr": np.full((n, c, b), _INVALID_ADDR, np.int32),
+        "snap_cache_val": np.zeros((n, c, b), np.int32),
+        "snap_cache_state": np.full((n, c, b), _I, np.int32),
+        "scalars": np.zeros((_NSCALAR, b), np.int32),
+        "msg_counts": np.zeros((_NTYPES, b), np.int32),
+    }
+    traces = {
+        "tr_op": np.ascontiguousarray(
+            np.moveaxis(tr_op.astype(np.int32), 0, -1)),
+        "tr_addr": np.ascontiguousarray(
+            np.moveaxis(tr_addr.astype(np.int32), 0, -1)),
+        "tr_val": np.ascontiguousarray(
+            np.moveaxis(tr_val.astype(np.int32), 0, -1)),
+        "tr_len": np.ascontiguousarray(
+            np.moveaxis(tr_len.astype(np.int32), 0, 1)),
+    }
+    return state, traces
+
+
+@functools.lru_cache(maxsize=16)
+def _build_call(config: SystemConfig, b: int, bb: int, k: int,
+                interpret: bool):
+    """Jitted pallas_call advancing every system by up to ``k`` cycles
+    (quiesced blocks skip), state resident in VMEM for the duration."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if b % bb != 0:
+        raise ValueError(f"batch {b} not divisible by block {bb}")
+    cycle = build_cycle(config, bb)
+    n, c, m = config.num_procs, config.cache_size, config.mem_size
+    cap, nt = config.msg_buffer_size, _NTYPES
+
+    shapes = {
+        "cache_addr": (n, c), "cache_val": (n, c), "cache_state": (n, c),
+        "mem": (n, m), "dir_state": (n, m), "dir_sharers": (n, m),
+        "mb": (n, _NFIELD, cap), "mb_count": (n,), "pc": (n,),
+        "waiting": (n,), "pending_write": (n,),
+        "snap_taken": (n,), "snap_mem": (n, m),
+        "snap_dir_state": (n, m), "snap_dir_sharers": (n, m),
+        "snap_cache_addr": (n, c), "snap_cache_val": (n, c),
+        "snap_cache_state": (n, c),
+        "scalars": (_NSCALAR,), "msg_counts": (nt,),
+    }
+
+    def kernel(*refs):
+        ntr = len(TRACE_FIELDS)
+        nst = len(STATE_FIELDS)
+        tr_refs = refs[:ntr]
+        in_refs = refs[ntr:ntr + nst]
+        out_refs = refs[ntr + nst:]
+        s = {name: in_refs[i][:] for i, name in enumerate(STATE_FIELDS)}
+        s.update(
+            {name: tr_refs[i][:] for i, name in enumerate(TRACE_FIELDS)}
+        )
+
+        def body(_, st):
+            done = jnp.all(quiescent_block(st))
+            return jax.lax.cond(done, lambda x: x, cycle, st)
+
+        s = jax.lax.fori_loop(0, k, body, s)
+        for i, name in enumerate(STATE_FIELDS):
+            out_refs[i][:] = s[name]
+
+    def block_spec(prefix_shape):
+        shape = tuple(prefix_shape) + (bb,)
+        nd = len(shape)
+        return pl.BlockSpec(
+            shape,
+            (lambda i, _nd=nd: (0,) * (_nd - 1) + (i,)),
+            memory_space=pltpu.VMEM,
+        )
+
+    def call(state: Dict[str, jnp.ndarray], traces: Dict[str, jnp.ndarray]):
+        t_dim = traces["tr_op"].shape[1]
+        tr_shapes = {
+            "tr_op": (n, t_dim), "tr_addr": (n, t_dim),
+            "tr_val": (n, t_dim), "tr_len": (n,),
+        }
+        in_specs = (
+            [block_spec(tr_shapes[f]) for f in TRACE_FIELDS]
+            + [block_spec(shapes[f]) for f in STATE_FIELDS]
+        )
+        out_specs = [block_spec(shapes[f]) for f in STATE_FIELDS]
+        out_shape = [
+            jax.ShapeDtypeStruct(tuple(shapes[f]) + (b,), jnp.int32)
+            for f in STATE_FIELDS
+        ]
+        aliases = {
+            len(TRACE_FIELDS) + i: i for i in range(len(STATE_FIELDS))
+        }
+        fn = pl.pallas_call(
+            kernel,
+            grid=(b // bb,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            input_output_aliases=aliases,
+            interpret=interpret,
+        )
+        args = [traces[f] for f in TRACE_FIELDS] + [
+            state[f] for f in STATE_FIELDS
+        ]
+        outs = fn(*args)
+        return dict(zip(STATE_FIELDS, outs))
+
+    return jax.jit(call)
+
+
+class PallasEngine:
+    """Ensemble engine with VMEM-resident cycles (the fast path).
+
+    Same observable behavior as :class:`BatchJaxEngine` — fixture
+    semantics, dump-at-local-completion snapshots, counters — at a
+    fraction of the per-cycle cost.  ``interpret=True`` runs the
+    kernel in the Pallas interpreter (CPU differential tests).
+    """
+
+    def __init__(
+        self,
+        config: SystemConfig,
+        tr_op: np.ndarray,
+        tr_addr: np.ndarray,
+        tr_val: np.ndarray,
+        tr_len: np.ndarray,
+        block: int = 128,
+        cycles_per_call: int = 128,
+        interpret: Optional[bool] = None,
+    ):
+        if interpret is None:
+            # the Mosaic kernel path needs a TPU; interpret elsewhere
+            # (match on the device, not default_backend(): the axon
+            # plugin reports platform "axon" for a real TPU chip)
+            interpret = not any(
+                "tpu" in str(d).lower() for d in jax.devices()
+            )
+        b = tr_op.shape[0]
+        self.config = config
+        self.b = b
+        # largest divisor of the batch not exceeding the requested
+        # block (the grid tiles the ensemble axis exactly)
+        block = min(block, b)
+        while b % block != 0:
+            block -= 1
+        self.block = block
+        self.cycles_per_call = cycles_per_call
+        state, traces = _init_transposed(
+            config, tr_op, tr_addr, tr_val, tr_len
+        )
+        self.state = {f: jnp.asarray(v) for f, v in state.items()}
+        self.traces = {f: jnp.asarray(v) for f, v in traces.items()}
+        self._call = _build_call(
+            config, b, self.block, cycles_per_call, interpret
+        )
+
+    def run(self, max_cycles: int = 1_000_000) -> "PallasEngine":
+        calls = 0
+        limit = max(1, -(-max_cycles // self.cycles_per_call))
+        while True:
+            self.state = self._call(self.state, self.traces)
+            calls += 1
+            if bool(jnp.any(self.state["scalars"][_SC_OVERFLOW] > 0)):
+                raise StallError(
+                    "mailbox capacity exceeded; raise msg_buffer_size"
+                )
+            if bool(
+                jnp.all(
+                    quiescent_block(
+                        {**self.state, "tr_len": self.traces["tr_len"]}
+                    )
+                )
+            ):
+                return self
+            if calls >= limit:
+                raise StallError(
+                    f"no quiescence after ~{calls * self.cycles_per_call} "
+                    "cycles (livelock? use Semantics.robust())"
+                )
+
+    # -- readback -----------------------------------------------------
+
+    def _dump(self, arrs, sys_idx: int) -> List[NodeDump]:
+        mem, dstate, dsh, caddr, cval, cstate = arrs
+        return [
+            NodeDump(
+                proc_id=i,
+                memory=[int(x) for x in mem[i, :, sys_idx]],
+                dir_state=[int(x) for x in dstate[i, :, sys_idx]],
+                dir_sharers=[
+                    int(np.uint32(x)) for x in dsh[i, :, sys_idx]
+                ],
+                cache_addr=[int(x) for x in caddr[i, :, sys_idx]],
+                cache_value=[int(x) for x in cval[i, :, sys_idx]],
+                cache_state=[int(x) for x in cstate[i, :, sys_idx]],
+            )
+            for i in range(self.config.num_procs)
+        ]
+
+    def system_snapshots(self, sys_idx: int) -> List[NodeDump]:
+        arrs = tuple(
+            np.asarray(self.state[f])
+            for f in ("snap_mem", "snap_dir_state", "snap_dir_sharers",
+                      "snap_cache_addr", "snap_cache_val",
+                      "snap_cache_state")
+        )
+        return self._dump(arrs, sys_idx)
+
+    def system_final_dumps(self, sys_idx: int) -> List[NodeDump]:
+        arrs = tuple(
+            np.asarray(self.state[f])
+            for f in ("mem", "dir_state", "dir_sharers",
+                      "cache_addr", "cache_val", "cache_state")
+        )
+        return self._dump(arrs, sys_idx)
+
+    @property
+    def instructions(self) -> int:
+        return int(np.sum(np.asarray(self.state["scalars"][_SC_INSTR])))
+
+    def stats(self) -> dict:
+        from hpa2_tpu.ops.engine import format_stats
+
+        sc = np.asarray(self.state["scalars"])
+        return format_stats(
+            {
+                "instructions": int(sc[_SC_INSTR].sum()),
+                "msgs_total": int(sc[_SC_MSGS].sum()),
+                "read_hits": int(sc[_SC_RH].sum()),
+                "read_misses": int(sc[_SC_RM].sum()),
+                "write_hits": int(sc[_SC_WH].sum()),
+                "write_misses": int(sc[_SC_WM].sum()),
+                "evictions": int(sc[_SC_EV].sum()),
+                "invalidations": int(sc[_SC_INV].sum()),
+            },
+            np.asarray(self.state["msg_counts"]).sum(axis=1),
+        )

@@ -256,3 +256,29 @@ def gen_uniform_random_arrays(
         (batch, config.num_procs), instrs_per_core, dtype=np.int32
     )
     return op, addr, val, length
+
+
+def traces_to_arrays(config: SystemConfig, batch_traces):
+    """[[Instr]] per system -> ([B,N,T] op/addr/val, [B,N] len) arrays
+    (the input format of the batched/Pallas engines)."""
+    import numpy as np
+
+    b = len(batch_traces)
+    n = config.num_procs
+    t = max(
+        (len(tr) for traces in batch_traces for tr in traces), default=1
+    )
+    op = np.full((b, n, t), -1, np.int32)
+    addr = np.zeros((b, n, t), np.int32)
+    val = np.zeros((b, n, t), np.int32)
+    length = np.zeros((b, n), np.int32)
+    for bi, traces in enumerate(batch_traces):
+        if len(traces) != n:
+            raise ValueError(f"system {bi}: need {n} traces")
+        for ni, tr in enumerate(traces):
+            length[bi, ni] = len(tr)
+            for j, ins in enumerate(tr):
+                op[bi, ni, j] = 0 if ins.op == "R" else 1
+                addr[bi, ni, j] = ins.address
+                val[bi, ni, j] = ins.value
+    return op, addr, val, length

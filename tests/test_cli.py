@@ -3,13 +3,10 @@ trace dir in, ``core_<n>_output.txt`` out (assignment.c:119-123, 831) —
 plus backend selection, replay, and the bench subcommand."""
 
 import json
-import pathlib
 
 import pytest
 
 from hpa2_tpu.cli import main
-
-REF = pathlib.Path("/root/reference/tests")
 
 
 @pytest.mark.parametrize("backend", ["spec", "jax"])

@@ -1,0 +1,102 @@
+"""Differential gates for the VMEM-resident Pallas engine
+(ops/pallas_engine.py) against the XLA engine — which is itself gated
+against the Python spec engine — on random workloads.
+
+Runs in Pallas interpreter mode (CPU); the kernel path is exercised on
+real TPU by bench.py.
+"""
+
+import numpy as np
+import pytest
+
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.models.protocol import Instr
+from hpa2_tpu.ops.engine import JaxEngine
+from hpa2_tpu.ops.pallas_engine import PallasEngine
+from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+
+def _traces_from_arrays(op, addr, val, b, n_procs):
+    return [
+        [
+            Instr("W", int(a), int(v)) if o == 1 else Instr("R", int(a))
+            for o, a, v in zip(op[b, n], addr[b, n], val[b, n])
+        ]
+        for n in range(n_procs)
+    ]
+
+
+def _dicts(dumps):
+    return [d.__dict__ for d in dumps]
+
+
+@pytest.mark.parametrize(
+    "n_procs,batch,block,t,seed",
+    [
+        (4, 4, 4, 24, 0),
+        (8, 6, 3, 20, 1),   # batch split over 2 grid blocks
+        (4, 2, 2, 40, 2),
+    ],
+)
+def test_pallas_matches_xla_engine(n_procs, batch, block, t, seed):
+    cfg = SystemConfig(
+        num_procs=n_procs, msg_buffer_size=64,
+        semantics=Semantics().robust(),
+    )
+    op, addr, val, length = gen_uniform_random_arrays(cfg, batch, t, seed=seed)
+    pe = PallasEngine(
+        cfg, op, addr, val, length, block=block, cycles_per_call=64,
+        interpret=True,
+    ).run()
+    total_spec = {}
+    for b in range(batch):
+        jx = JaxEngine(
+            cfg, _traces_from_arrays(op, addr, val, b, n_procs)
+        ).run()
+        assert _dicts(jx.final_dumps()) == _dicts(pe.system_final_dumps(b))
+        assert _dicts(jx.snapshots()) == _dicts(pe.system_snapshots(b))
+        for k, v in jx.stats().items():
+            total_spec[k] = total_spec.get(k, 0) + v
+    ps = pe.stats()
+    for k in set(ps) | set(total_spec):
+        assert total_spec.get(k, 0) == ps.get(k, 0), (
+            f"{k}: xla={total_spec.get(k, 0)} pallas={ps.get(k, 0)}"
+        )
+
+
+def test_pallas_parity_semantics_default_drop():
+    """Local-only traffic runs clean under the parity (drop) policy."""
+    cfg = SystemConfig(num_procs=4, msg_buffer_size=32)
+    from hpa2_tpu.utils.trace import gen_local_only
+
+    traces = gen_local_only(cfg, 24, seed=3)
+    op = np.full((1, 4, 24), -1, np.int32)
+    addr = np.zeros((1, 4, 24), np.int32)
+    val = np.zeros((1, 4, 24), np.int32)
+    length = np.zeros((1, 4), np.int32)
+    for n, tr in enumerate(traces):
+        length[0, n] = len(tr)
+        for j, ins in enumerate(tr):
+            op[0, n, j] = 0 if ins.op == "R" else 1
+            addr[0, n, j] = ins.address
+            val[0, n, j] = ins.value
+    pe = PallasEngine(
+        cfg, op, addr, val, length, block=1, cycles_per_call=64,
+        interpret=True,
+    ).run()
+    jx = JaxEngine(cfg, traces).run()
+    assert _dicts(jx.final_dumps()) == _dicts(pe.system_final_dumps(0))
+
+
+def test_pallas_overflow_detected():
+    cfg = SystemConfig(
+        num_procs=8, msg_buffer_size=4, semantics=Semantics().robust()
+    )
+    op, addr, val, length = gen_uniform_random_arrays(cfg, 2, 64, seed=0)
+    from hpa2_tpu.models.spec_engine import StallError
+
+    with pytest.raises(StallError, match="capacity"):
+        PallasEngine(
+            cfg, op, addr, val, length, block=2, cycles_per_call=32,
+            interpret=True,
+        ).run()
