@@ -8,17 +8,33 @@ chip.  Primary engine: the VMEM-resident Pallas kernel
 (ops/pallas_engine.py); falls back to the XLA ``lax.while_loop``
 engine if the kernel path fails.  Baseline: the C++/OpenMP engine on
 the same uniform-random workload shape (both sides report a rate, so
-instruction volumes need not match).  Prints ONE JSON line.
+instruction volumes need not match).
+
+ALWAYS prints exactly ONE JSON line on stdout.  The axon TPU tunnel
+can hang or refuse backend init (round-1 artifact: rc=1, no JSON), so
+the parent process never touches JAX itself: it probes the TPU in a
+timeout-guarded subprocess (one retry), runs the measurement in a
+second subprocess (TPU env or forced-CPU fallback env), and if every
+child fails it still emits a JSON line with a ``note``.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-from hpa2_tpu.config import Semantics, SystemConfig
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+_PROBE_TIMEOUT_S = 90
+_TPU_CHILD_TIMEOUT_S = 540
+_CPU_CHILD_TIMEOUT_S = 300
 
+
+# ---------------------------------------------------------------------------
+# child: the actual measurement (runs under a known-good platform env)
+# ---------------------------------------------------------------------------
 
 def bench_pallas(config, batch, instrs_per_core, seed=0):
     from hpa2_tpu.ops.pallas_engine import PallasEngine
@@ -40,6 +56,7 @@ def bench_jax(config, batch, instrs_per_core, seed=0):
 
     from hpa2_tpu.ops.engine import build_batched_run
     from hpa2_tpu.ops.state import init_state_batched
+    from hpa2_tpu.ops.step import quiescent
     from hpa2_tpu.utils.trace import gen_uniform_random_arrays
 
     state = init_state_batched(
@@ -57,8 +74,6 @@ def bench_jax(config, batch, instrs_per_core, seed=0):
     out = once()
     dt = time.perf_counter() - t0
     assert not bool(jnp.any(out.overflow)), "mailbox overflow"
-    from hpa2_tpu.ops.step import quiescent
-
     assert bool(jnp.all(jax.vmap(quiescent)(out))), (
         "batch hit max_cycles before quiescence; throughput would be "
         "measured over a partial workload"
@@ -76,13 +91,13 @@ def bench_omp(config, instrs_per_core, seed=0):
     return int(res.instructions), float(res.seconds)
 
 
-def main():
+def child_main(platform: str) -> int:
+    from hpa2_tpu.config import Semantics, SystemConfig
+
     config = SystemConfig(
         num_procs=8, msg_buffer_size=32, semantics=Semantics().robust()
     )
-    import jax
-
-    on_tpu = any("tpu" in str(d).lower() for d in jax.devices())
+    on_tpu = platform == "tpu"
     if on_tpu:
         batch, instrs_per_core = 8192, 128  # 8.4M instrs
     else:  # CPU smoke (pallas runs interpreted): keep it tiny
@@ -100,31 +115,142 @@ def main():
         jax_instrs, jax_dt = bench_jax(config, batch, instrs_per_core)
     jax_ops = jax_instrs / jax_dt
 
-    try:
-        omp_instrs, omp_dt = bench_omp(config, instrs_per_core=50_000)
-        omp_ops = omp_instrs / omp_dt
-    except Exception as e:  # baseline unavailable: report jax-only
-        print(json.dumps({
-            "metric": "sim_ops_per_sec_jax",
-            "value": round(jax_ops, 1),
-            "unit": "RD/WR ops/sec",
-            "vs_baseline": None,
-            "note": f"omp baseline failed: {e}",
-        }))
-        return 0
-
-    print(json.dumps({
+    result = {
         "metric": "sim_ops_per_sec_jax",
         "value": round(jax_ops, 1),
         "unit": "RD/WR ops/sec",
-        "vs_baseline": round(jax_ops / omp_ops, 2),
+        "vs_baseline": None,
         "engine": engine,
+        "platform": platform,
         "jax_instrs": jax_instrs,
         "jax_seconds": round(jax_dt, 4),
-        "omp_ops_per_sec": round(omp_ops, 1),
-        "omp_instrs": omp_instrs,
-        "omp_seconds": round(omp_dt, 4),
-    }))
+    }
+    try:
+        omp_instrs, omp_dt = bench_omp(config, instrs_per_core=50_000)
+        omp_ops = omp_instrs / omp_dt
+        result.update(
+            vs_baseline=round(jax_ops / omp_ops, 2),
+            omp_ops_per_sec=round(omp_ops, 1),
+            omp_instrs=omp_instrs,
+            omp_seconds=round(omp_dt, 4),
+        )
+    except Exception as e:  # baseline unavailable: report jax-only
+        result["note"] = f"omp baseline failed: {e}"
+    print(json.dumps(result))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: platform probe + subprocess orchestration, always one JSON line
+# ---------------------------------------------------------------------------
+
+def _hostenv():
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    from hpa2_tpu import hostenv
+
+    return hostenv
+
+
+def _probe_tpu() -> bool:
+    """True iff a fresh interpreter sees a TPU within the timeout.
+    One retry on timeout/crash only — rc=3 ("no TPU present") is a
+    deterministic answer, not tunnel flakiness."""
+    code = (
+        "import sys, jax; ds = jax.devices(); "
+        "sys.exit(0 if any('tpu' in str(d).lower() for d in ds) else 3)"
+    )
+    for attempt in range(2):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                env=_hostenv().cache_env(dict(os.environ)),
+                cwd=_REPO_ROOT,
+                timeout=_PROBE_TIMEOUT_S,
+                capture_output=True,
+            )
+            if proc.returncode == 0:
+                return True
+            print(
+                f"tpu probe attempt {attempt + 1}: rc={proc.returncode} "
+                f"{proc.stderr.decode(errors='replace')[-200:]!r}",
+                file=sys.stderr,
+            )
+            if proc.returncode == 3:
+                return False
+        except subprocess.TimeoutExpired:
+            print(
+                f"tpu probe attempt {attempt + 1}: timeout "
+                f"({_PROBE_TIMEOUT_S}s)",
+                file=sys.stderr,
+            )
+    return False
+
+
+def _run_child(platform: str, timeout_s: int):
+    """Run the measurement child; returns the parsed JSON dict or None."""
+    try:
+        hostenv = _hostenv()
+        env = (
+            hostenv.cache_env(dict(os.environ))
+            if platform == "tpu"
+            else hostenv.forced_cpu_env()
+        )
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", platform],
+            env=env,
+            cwd=_REPO_ROOT,
+            timeout=timeout_s,
+            capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"{platform} bench child: timeout ({timeout_s}s)",
+              file=sys.stderr)
+        return None
+    sys.stderr.write(proc.stderr.decode(errors="replace"))
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    print(f"{platform} bench child: rc={proc.returncode}, no JSON line",
+          file=sys.stderr)
+    return None
+
+
+def main() -> int:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        return child_main(sys.argv[2])
+
+    tpu_ok = _probe_tpu()
+    result = None
+    if tpu_ok:
+        result = _run_child("tpu", _TPU_CHILD_TIMEOUT_S)
+    if result is None:
+        result = _run_child("cpu", _CPU_CHILD_TIMEOUT_S)
+        if result is not None:
+            why = (
+                "tpu measurement child failed"
+                if tpu_ok
+                else "tpu unavailable"
+            )
+            result["note"] = (
+                result.get("note", "") + f" {why}; cpu smoke result"
+            ).strip()
+    if result is None:  # every path failed: still emit the JSON line
+        result = {
+            "metric": "sim_ops_per_sec_jax",
+            "value": 0.0,
+            "unit": "RD/WR ops/sec",
+            "vs_baseline": None,
+            "engine": None,
+            "platform": None,
+            "note": "all bench paths failed (tpu probe "
+                    f"{'ok' if tpu_ok else 'failed'}; see stderr)",
+        }
+    print(json.dumps(result))
     return 0
 
 

@@ -1,0 +1,52 @@
+"""Host-process JAX environment recipes (jax-free; safe to import
+before backend init).
+
+The axon sitecustomize registers the TPU PJRT plugin at interpreter
+startup and pins the backend, and its init can hang on the tunnel —
+an in-process ``JAX_PLATFORMS`` override is too late.  Every entry
+point that needs a guaranteed-CPU JAX (tests, the driver dryrun, the
+bench fallback) therefore re-execs or spawns a fresh interpreter with
+THIS environment.  Keep the recipe here only: it has three consumers
+(tests/conftest.py, __graft_entry__.py, bench.py) and drift between
+them reintroduces the round-1 rc=124 hang in whichever copy is stale.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_DEVICE_COUNT_FLAG = "xla_force_host_platform_device_count"
+
+
+def cache_env(env: dict) -> dict:
+    """Persistent XLA compile cache (the jitted programs are identical
+    across runs, so recompiles dominate otherwise)."""
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/hpa2_jax_cache")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    return env
+
+
+def forced_cpu_env(
+    base: Optional[dict] = None, n_devices: Optional[int] = None
+) -> dict:
+    """A copy of ``base`` (default: os.environ) forcing the CPU backend
+    with ``n_devices`` virtual devices (None = leave any existing
+    device-count flag untouched)."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # disable axon TPU registration
+    if n_devices is not None:
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if _DEVICE_COUNT_FLAG not in f
+        ]
+        flags.append(f"--{_DEVICE_COUNT_FLAG}={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return cache_env(env)
+
+
+def has_device_count_flag(env: Optional[dict] = None) -> bool:
+    source = os.environ if env is None else env
+    return _DEVICE_COUNT_FLAG in source.get("XLA_FLAGS", "")

@@ -17,15 +17,15 @@ def pytest_configure(config):
     # be released first or the child writes into pytest's temp file.
     if os.environ.get("_HPA2_TEST_REEXEC") == "1":
         return
-    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:  # bare `pytest` puts only tests/ on path
+        sys.path.insert(0, repo_root)
+    from hpa2_tpu.hostenv import forced_cpu_env, has_device_count_flag
+
+    env = forced_cpu_env(
+        n_devices=None if has_device_count_flag() else 8
+    )
     env["_HPA2_TEST_REEXEC"] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PALLAS_AXON_POOL_IPS"] = ""  # disable axon TPU registration
-    xla_flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in xla_flags:
-        env["XLA_FLAGS"] = (
-            xla_flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
     capman = config.pluginmanager.getplugin("capturemanager")
     if capman is not None:
         capman.stop_global_capturing()
@@ -36,12 +36,9 @@ import pathlib
 
 import pytest
 
-# persistent XLA compilation cache: the jitted step/run programs are
-# identical across test runs, so recompiles dominate otherwise
-import jax
-
-jax.config.update("jax_compilation_cache_dir", "/tmp/hpa2_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# (the persistent XLA compile cache is configured via the re-exec env:
+# hostenv.cache_env sets JAX_COMPILATION_CACHE_DIR and the min-compile
+# threshold, which jax reads at import)
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 REFERENCE_TESTS = pathlib.Path("/root/reference/tests")
