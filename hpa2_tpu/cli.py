@@ -62,8 +62,23 @@ def _write_dumps(dumps, config, out_dir: str) -> List[str]:
     return paths
 
 
+def _check_shard_args(args) -> None:
+    if (args.node_shards > 1 or args.data_shards > 1) and args.backend != "jax":
+        raise SystemExit(
+            "--node-shards/--data-shards are jax-backend features "
+            "(device-mesh sharding; the omp/spec/pallas backends are "
+            "single-host)"
+        )
+
+
 def cmd_run(args) -> int:
     config = _build_config(args)
+    _check_shard_args(args)
+    if args.data_shards > 1:
+        raise SystemExit(
+            "--data-shards applies to bench (--batch > 1 ensembles); "
+            "run simulates one system"
+        )
     out_dir = args.out or os.getcwd()
     os.makedirs(out_dir, exist_ok=True)
 
@@ -78,6 +93,7 @@ def cmd_run(args) -> int:
             replay_path=args.replay,
             final_dump=args.final_dump,
             max_cycles=args.max_cycles,
+            record_order_path=args.record_order,
         )
         print(
             f"[omp] {res.instructions} instrs, {res.messages} msgs, "
@@ -97,13 +113,49 @@ def cmd_run(args) -> int:
 
         eng = SpecEngine(config, traces, replay_order=replay)
         eng.run(max_cycles=args.max_cycles)
-    else:
-        from hpa2_tpu.ops.engine import JaxEngine
+        if args.record_order:
+            from hpa2_tpu.utils.trace import format_instruction_order
 
-        eng = JaxEngine(
-            config, traces, replay_order=replay, max_cycles=args.max_cycles
-        )
-        eng.run()
+            with open(args.record_order, "w") as f:
+                f.write(format_instruction_order(eng.issue_log))
+    else:
+        if args.record_order:
+            raise SystemExit(
+                "--record-order is supported by the spec and omp "
+                "backends (the jax backend runs entirely on device; "
+                "its deterministic schedule is identical to the spec "
+                "engine's, so record there)"
+            )
+        if args.node_shards > 1:
+            # multi-chip: shard the simulated-node axis over the mesh
+            # (cross-shard delivery = one ICI all_gather per cycle);
+            # bit-identical to the single-chip engine
+            if replay is not None:
+                raise SystemExit(
+                    "--replay is single-shard only (fixture replays "
+                    "are tiny 4-node systems)"
+                )
+            from hpa2_tpu.parallel.sharding import (
+                NodeShardedEngine,
+                make_mesh,
+            )
+
+            eng = NodeShardedEngine(
+                config,
+                traces,
+                mesh=make_mesh(node_shards=args.node_shards,
+                               data_shards=1),
+                max_cycles=args.max_cycles,
+            )
+            eng.run()
+        else:
+            from hpa2_tpu.ops.engine import JaxEngine
+
+            eng = JaxEngine(
+                config, traces, replay_order=replay,
+                max_cycles=args.max_cycles,
+            )
+            eng.run()
     dt = time.perf_counter() - t0
 
     dumps = eng.final_dumps() if args.final_dump else eng.snapshots()
@@ -118,6 +170,12 @@ def cmd_run(args) -> int:
 
 def cmd_bench(args) -> int:
     config = _build_config(args)
+    _check_shard_args(args)
+    if args.data_shards > 1 and args.batch <= 1:
+        raise SystemExit(
+            "--data-shards > 1 needs --batch > 1 (an ensemble to "
+            "shard); a single system would only be replicated"
+        )
     from hpa2_tpu.utils.trace import (
         gen_local_only,
         gen_producer_consumer,
@@ -170,6 +228,37 @@ def cmd_bench(args) -> int:
         eng.run(args.max_cycles)
         dt = time.perf_counter() - t0
         instrs = eng.instructions
+    elif args.node_shards > 1 or args.data_shards > 1:
+        # multi-chip bench: node axis and/or ensemble axis sharded over
+        # the device mesh (GridEngine = shard_map(vmap(step)))
+        from hpa2_tpu.parallel.sharding import (
+            GridEngine,
+            NodeShardedEngine,
+            make_mesh,
+        )
+
+        mesh = make_mesh(
+            node_shards=args.node_shards, data_shards=args.data_shards
+        )
+        if args.batch > 1:
+            batch_traces = [
+                gen(config, args.instrs, seed=args.seed + b)
+                for b in range(args.batch)
+            ]
+            mk = lambda: GridEngine(
+                config, batch_traces, mesh=mesh, max_cycles=args.max_cycles
+            )
+        else:
+            traces = gen(config, args.instrs, seed=args.seed)
+            mk = lambda: NodeShardedEngine(
+                config, traces, mesh=mesh, max_cycles=args.max_cycles
+            )
+        mk().run()  # warmup/compile
+        eng = mk()
+        t0 = time.perf_counter()
+        eng.run()
+        dt = time.perf_counter() - t0
+        instrs = eng.instructions
     elif args.batch > 1:
         import jax
         import jax.numpy as jnp
@@ -196,11 +285,66 @@ def cmd_bench(args) -> int:
                 ],
             )
         state = init_state_batched(config, *arrays)
-        run = build_batched_run(config, max_cycles=args.max_cycles)
-        jax.block_until_ready(run(state))  # warmup/compile
-        t0 = time.perf_counter()
-        out = jax.block_until_ready(run(state))
-        dt = time.perf_counter() - t0
+        if args.checkpoint_every:
+            # chunked advance with periodic durable checkpoints (and
+            # auto-resume), so long runs survive TPU-tunnel flakiness
+            from hpa2_tpu.ops.engine import build_batched_run_chunk
+            from hpa2_tpu.utils.checkpoint import (
+                latest_checkpoint,
+                load_state,
+                save_state,
+            )
+
+            ckdir = args.checkpoint_dir
+            os.makedirs(ckdir, exist_ok=True)
+            workload_meta = {
+                "batch": args.batch, "instrs": args.instrs,
+                "workload": args.workload, "seed": args.seed,
+            }
+            resume = latest_checkpoint(ckdir)
+            if resume is not None:
+                state, ck_config, ck_meta = load_state(
+                    resume, with_meta=True
+                )
+                if ck_config != config or ck_meta != workload_meta:
+                    raise SystemExit(
+                        f"checkpoint {resume} was written for a "
+                        "different config/workload; use a fresh "
+                        "--checkpoint-dir"
+                    )
+                print(f"resumed from {resume}", file=sys.stderr)
+            run_chunk = build_batched_run_chunk(
+                config, args.checkpoint_every
+            )
+            vq = jax.vmap(quiescent)
+            jax.block_until_ready(run_chunk(state))  # warmup/compile
+            t0 = time.perf_counter()
+            out = state
+            k = int(jnp.max(out.cycle)) // args.checkpoint_every
+            while not bool(jnp.all(vq(out))):
+                if bool(jnp.any(out.overflow)):
+                    raise StallError(
+                        "internal invariant violated: mailbox overflow "
+                        "despite backpressure"
+                    )
+                if int(jnp.max(out.cycle)) >= args.max_cycles:
+                    raise StallError("batch did not reach quiescence")
+                out = jax.block_until_ready(run_chunk(out))
+                k += 1
+                save_state(os.path.join(ckdir, f"ckpt_{k}.npz"), out,
+                           config, extra_meta=workload_meta)
+            dt = time.perf_counter() - t0
+            # completed: clear the checkpoints so a rerun starts fresh
+            # instead of instantly "resuming" the quiescent final state
+            for name in os.listdir(ckdir):
+                if name.startswith("ckpt_") and name.endswith(".npz"):
+                    os.remove(os.path.join(ckdir, name))
+        else:
+            run = build_batched_run(config, max_cycles=args.max_cycles)
+            jax.block_until_ready(run(state))  # warmup/compile
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(run(state))
+            dt = time.perf_counter() - t0
         if bool(jnp.any(out.overflow)) or not bool(
             jnp.all(jax.vmap(quiescent)(out))
         ):
@@ -224,6 +368,8 @@ def cmd_bench(args) -> int:
                 "workload": args.workload,
                 "nodes": config.num_procs,
                 "batch": args.batch,
+                "node_shards": args.node_shards,
+                "data_shards": args.data_shards,
                 "instrs": instrs,
                 "seconds": round(dt, 4),
                 "ops_per_sec": round(instrs / dt, 1),
@@ -234,6 +380,17 @@ def cmd_bench(args) -> int:
 
 
 def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--node-shards", type=int, default=1,
+        help="jax backend: shard the simulated-node axis over this "
+        "many devices (cross-shard mailbox delivery rides one ICI "
+        "all_gather per cycle; bit-identical to single-chip)",
+    )
+    p.add_argument(
+        "--data-shards", type=int, default=1,
+        help="jax bench with --batch > 1: shard the ensemble axis "
+        "over this many devices (the DP analog)",
+    )
     p.add_argument("--nodes", type=int, default=4)
     p.add_argument("--cache-size", type=int, default=4)
     p.add_argument("--mem-size", type=int, default=16)
@@ -277,6 +434,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--replay", help="instruction_order.txt to replay", default=None
     )
     rp.add_argument(
+        "--record-order", default=None, metavar="PATH",
+        help="write the executed issue interleaving in DEBUG_INSTR "
+        "format (replayable via --replay; mints new fixture run-sets)",
+    )
+    rp.add_argument(
         "--final-dump", action="store_true",
         help="dump final quiescent state instead of at local completion",
     )
@@ -295,6 +457,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     bp.add_argument("--instrs", type=int, default=1000)
     bp.add_argument("--batch", type=int, default=1)
     bp.add_argument("--seed", type=int, default=0)
+    bp.add_argument(
+        "--checkpoint-every", type=int, default=0, metavar="CYCLES",
+        help="jax backend with --batch > 1: checkpoint the full state "
+        "every CYCLES cycles and auto-resume from the latest "
+        "checkpoint in --checkpoint-dir (long runs survive TPU-tunnel "
+        "flakiness)",
+    )
+    bp.add_argument("--checkpoint-dir", default="hpa2_ckpt")
     _add_common(bp)
     bp.set_defaults(fn=cmd_bench)
 

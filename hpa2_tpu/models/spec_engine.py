@@ -95,6 +95,13 @@ class Node:
         self.waiting = False
         self.pending_write = 0
         self.mailbox: Deque[Message] = collections.deque()
+        # deferred sends: messages from this node's last action that
+        # did not fit their receiver's mailbox (capacity backpressure).
+        # While non-empty the node is BLOCKED — it neither handles nor
+        # issues — the lockstep analog of the reference's blocking
+        # enqueue (assignment.c:715-724, busy-wait on full buffer).
+        # Entries are (phase, receiver, Message) in emission order.
+        self.pending_sends: List[Tuple[int, int, Message]] = []
         self.dumped = False
         self.snapshot: Optional[NodeDump] = None
         # every legal dump-at-local-completion state: the state at
@@ -157,6 +164,9 @@ class SpecEngine:
         # observability (the reference has none — SURVEY.md §5)
         self.counters: Dict[str, int] = collections.defaultdict(int)
         self.max_mailbox_depth = 0
+        # the executed issue interleaving, one IssueRecord per issued
+        # instruction — the DEBUG_INSTR log (assignment.c:596-597)
+        self.issue_log: List[IssueRecord] = []
 
     @property
     def instructions(self) -> int:
@@ -175,16 +185,38 @@ class SpecEngine:
         self.counters["msgs_total"] += 1
         self._outbox.append((phase, msg.sender, receiver, msg))
 
-    def _deliver(self) -> None:
-        # handle-phase sends before issue-phase sends; within a phase,
-        # sender-major, preserving emission order (stable sort).
-        self._outbox.sort(key=lambda t: (t[0], t[1]))
-        for _, _, receiver, msg in self._outbox:
-            box = self.nodes[receiver].mailbox
-            box.append(msg)
-            if len(box) > self.max_mailbox_depth:
-                self.max_mailbox_depth = len(box)
+    def _deliver(self) -> bool:
+        """End-of-cycle delivery with capacity backpressure.
+
+        Candidates are walked in the global deterministic order
+        (phase, sender, emission order) — pending (deferred) sends at
+        their original positions, this cycle's new sends at theirs (a
+        node never has both: blocked nodes don't act).  A candidate is
+        accepted iff its receiver's mailbox has a free slot at that
+        point of the walk; rejected candidates become (stay) the
+        sender's pending_sends, preserving order.  Returns True if any
+        message was delivered (progress).
+        """
+        cap = self.config.msg_buffer_size
+        merged: List[Tuple[int, int, int, Message]] = []
+        for node in self.nodes:
+            for ph, receiver, msg in node.pending_sends:
+                merged.append((ph, node.id, receiver, msg))
+            node.pending_sends = []
+        merged.extend(self._outbox)
         self._outbox.clear()
+        merged.sort(key=lambda t: (t[0], t[1]))  # stable
+        delivered_any = False
+        for ph, sender, receiver, msg in merged:
+            box = self.nodes[receiver].mailbox
+            if len(box) < cap:
+                box.append(msg)
+                delivered_any = True
+                if len(box) > self.max_mailbox_depth:
+                    self.max_mailbox_depth = len(box)
+            else:
+                self.nodes[sender].pending_sends.append((ph, receiver, msg))
+        return delivered_any
 
     # -- cache replacement (assignment.c:742-773) ---------------------
 
@@ -543,6 +575,12 @@ class SpecEngine:
         instr = node.trace[node.pc]
         node.pc += 1
         self.counters["instructions"] += 1
+        self.issue_log.append(
+            IssueRecord(
+                proc=node.id, op=instr.op, address=instr.address,
+                value=instr.value,
+            )
+        )
         PH = 1  # issue phase
         cfg = self.config
         home = cfg.home_of(instr.address)
@@ -604,9 +642,11 @@ class SpecEngine:
         progress = False
         handled = [False] * len(self.nodes)
 
-        # 1. handle: one message per node
+        # 1. handle: one message per node (blocked nodes — those with
+        # deferred sends — stall entirely, like a reference thread
+        # blocked inside sendMessage, assignment.c:715-724)
         for node in self.nodes:
-            if node.mailbox:
+            if node.mailbox and not node.pending_sends:
                 msg = node.mailbox.popleft()
                 self._handle(node, msg)
                 handled[node.id] = True
@@ -622,6 +662,7 @@ class SpecEngine:
                     node.id not in issued
                     and not node.mailbox
                     and not node.waiting
+                    and not node.pending_sends
                     and node.pc < len(node.trace)
                 )
                 if not ready:
@@ -640,19 +681,30 @@ class SpecEngine:
                     break
         else:
             for node in self.nodes:
-                if not node.mailbox and not node.waiting and node.pc < len(node.trace):
+                if (
+                    not node.mailbox
+                    and not node.waiting
+                    and not node.pending_sends
+                    and node.pc < len(node.trace)
+                ):
                     self._issue(node)
                     progress = True
 
-        # 3. deliver
-        if self._outbox:
-            self._deliver()
+        # 3. deliver (capacity backpressure; delivering a previously
+        # deferred send is progress even in an otherwise idle cycle)
+        if self._outbox or any(n.pending_sends for n in self.nodes):
+            if self._deliver():
+                progress = True
 
         # 4. dump-at-local-completion snapshots.  The canonical dump is
         # the *earliest* legal one; every later post-completion state is
         # kept as a candidate (see Node.dump_candidates).
         for node in self.nodes:
-            if node.pc >= len(node.trace) and not node.waiting:
+            if (
+                node.pc >= len(node.trace)
+                and not node.waiting
+                and not node.pending_sends
+            ):
                 if not node.dumped:
                     if not node.mailbox:
                         node.dumped = True
@@ -667,7 +719,10 @@ class SpecEngine:
 
     def quiescent(self) -> bool:
         return all(
-            n.pc >= len(n.trace) and not n.waiting and not n.mailbox
+            n.pc >= len(n.trace)
+            and not n.waiting
+            and not n.mailbox
+            and not n.pending_sends
             for n in self.nodes
         ) and (self.replay_order is None or self.order_pos >= len(self.replay_order))
 
@@ -681,10 +736,13 @@ class SpecEngine:
                 stall += 1
                 if stall > 2:
                     waiting = [n.id for n in self.nodes if n.waiting]
+                    blocked = [n.id for n in self.nodes if n.pending_sends]
                     raise StallError(
-                        f"livelock at cycle {self.cycle}: nodes {waiting} wait "
-                        "forever (stale intervention dropped? use "
-                        "Semantics.intervention_miss_policy='nack')"
+                        f"livelock at cycle {self.cycle}: waiting nodes "
+                        f"{waiting}, send-blocked nodes {blocked} "
+                        "(stale intervention dropped? cyclic full "
+                        "mailboxes? use Semantics.intervention_miss_"
+                        "policy='nack' / a larger msg_buffer_size)"
                     )
             else:
                 stall = 0

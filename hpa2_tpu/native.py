@@ -58,7 +58,7 @@ def _load():
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
             ctypes.c_int, ctypes.c_ulonglong, ctypes.c_int,
-            ctypes.POINTER(Hpa2Result),
+            ctypes.c_char_p, ctypes.POINTER(Hpa2Result),
         ]
         lib.hpa2_bench_random.restype = ctypes.c_int
         lib.hpa2_bench_random.argtypes = [
@@ -88,9 +88,15 @@ def run_trace_dir(
     final_dump: bool = False,
     max_cycles: int = 100_000_000,
     threads: int = 0,
+    record_order_path: Optional[str] = None,
 ) -> Hpa2Result:
     """Run the native engine on a trace directory.  Dump files are
-    written to ``out_dir`` in the reference format."""
+    written to ``out_dir`` in the reference format.
+
+    ``record_order_path`` writes the executed issue interleaving in
+    DEBUG_INSTR format (assignment.c:596-597) — replayable on any
+    lockstep engine (the record->replay->verify workflow that produced
+    the reference's multi-run fixtures, SURVEY.md §4)."""
     _check_config(config)
     lib = _load()
     res = Hpa2Result()
@@ -101,7 +107,8 @@ def run_trace_dir(
         config.msg_buffer_size, config.max_instr_num,
         1 if config.semantics.intervention_miss_policy == "nack" else 0,
         (replay_path or "").encode(), int(candidates), int(final_dump),
-        max_cycles, threads, ctypes.byref(res),
+        max_cycles, threads, (record_order_path or "").encode(),
+        ctypes.byref(res),
     )
     if rc != 0 or not res.ok:
         raise NativeError(res.error.decode() or "native run failed")

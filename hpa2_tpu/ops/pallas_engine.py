@@ -60,6 +60,15 @@ _INVALID_ADDR = -1
 _F_TYPE, _F_SENDER, _F_ADDR, _F_VALUE, _F_SECOND, _F_SHARERS = range(6)
 _NFIELD = 6
 
+# deferred-send outbox rows (ob[:, row, slot, :]): the mailbox rows
+# plus the receiver; slots are the candidate grid [A0, A1, AINV, B0,
+# B1].  Slot 2 (AINV) keeps the *remaining* INV delivery mask in its
+# SHARERS row.  A node with any valid slot is blocked (capacity
+# backpressure; mirrors ops/step.py and the spec engine).
+_OB_RECV = _NFIELD
+_OB_NROWS = _NFIELD + 1
+_NSLOTS = 5
+
 # scalar counter rows (scalars[row, :])
 (_SC_CYCLE, _SC_INSTR, _SC_MSGS, _SC_OVERFLOW, _SC_RH, _SC_RM,
  _SC_WH, _SC_WM, _SC_EV, _SC_INV) = range(10)
@@ -72,6 +81,7 @@ STATE_FIELDS = (
     "cache_addr", "cache_val", "cache_state",
     "mem", "dir_state", "dir_sharers",
     "mb", "mb_count", "pc", "waiting", "pending_write",
+    "ob", "ob_valid",
     "snap_taken", "snap_mem", "snap_dir_state", "snap_dir_sharers",
     "snap_cache_addr", "snap_cache_val", "snap_cache_state",
     "scalars", "msg_counts",
@@ -147,8 +157,11 @@ def build_cycle(config: SystemConfig, bb: int):
         def write_m(arr, idx, mask, val):
             hot = (iota_m == idx[:, None, :]) & mask[:, None, :]
             return jnp.where(hot, val[:, None, :], arr)
+        # nodes with deferred sends are blocked (no handle, no issue)
+        blocked = jnp.sum(s["ob_valid"], axis=1) > 0        # [N, B]
+
         # ===== phase A: handle one message per node ==================
-        has_msg = s["mb_count"] > 0
+        has_msg = (s["mb_count"] > 0) & ~blocked
         head = s["mb"][:, :, 0, :]                       # [N, F, B]
         mt = jnp.where(has_msg, head[:, _F_TYPE, :], _NO_MSG)
         snd = head[:, _F_SENDER, :]
@@ -431,7 +444,7 @@ def build_cycle(config: SystemConfig, bb: int):
 
         # ===== phase B: instruction issue ============================
         tr_len = s["tr_len"]
-        elig = (count2 == 0) & ~waiting & (s["pc"] < tr_len)
+        elig = (count2 == 0) & ~waiting & ~blocked & (s["pc"] < tr_len)
         t_dim = s["tr_op"].shape[1]
         pcc = jnp.minimum(s["pc"], t_dim - 1)
         iota_tr = jax.lax.broadcasted_iota(I32, (n, t_dim, bb), 1)
@@ -475,21 +488,52 @@ def build_cycle(config: SystemConfig, bb: int):
         cache_state = write_c(cache_state, ci2, i_upd, n2_state)
         pc = s["pc"] + elig.astype(I32)
 
+        # merge deferred sends back into their candidate-grid slots
+        # (blocked nodes made no new sends, so the where-merge is exact)
+        ob, obv = s["ob"], s["ob_valid"]
+
+        def merge_slot(sl, k):
+            pv = obv[:, k, :] != 0
+            sl["valid"] = sl["valid"] | pv
+            for name, row in (
+                ("recv", _OB_RECV), ("type", _F_TYPE), ("addr", _F_ADDR),
+                ("value", _F_VALUE), ("second", _F_SECOND),
+                ("sharers", _F_SHARERS),
+            ):
+                sl[name] = jnp.where(pv, ob[:, row, k, :], sl[name])
+
+        merge_slot(sA0, 0)
+        merge_slot(sA1, 1)
+        pend_inv = obv[:, 2, :] != 0
+        inv_sharers = jnp.where(pend_inv, ob[:, _F_SHARERS, 2, :], inv_sharers)
+        inv_addr = jnp.where(pend_inv, ob[:, _F_ADDR, 2, :], inv_addr)
+        merge_slot(sB0, 3)
+        merge_slot(sB1, 4)
+
         # ===== phase C: deterministic delivery =======================
         # candidate order matches ops/step.py exactly: phase A sends
         # sender-major over slots [sA0, sA1, inv], then phase B over
         # [sB0, sB1] (assignment.c:711-739's locked enqueue becomes a
-        # fixed traversal)
+        # fixed traversal).  Each candidate is accepted only while the
+        # receiver's queue has space; rejected candidates defer to the
+        # sender's outbox (capacity backpressure, as in ops/step.py).
         mb = qdata
         acc = zero  # running enqueue offset per receiver
         msgs_delivered = jnp.zeros((1, bb), dtype=I32)
         mc_inc = jnp.zeros((_NTYPES, bb), dtype=I32)
+        # rejected-candidate collectors: [slot][sender] -> [B] rows
+        rej_valid = [[None] * n for _ in range(_NSLOTS)]
+        rej_rows = [
+            [[None] * n for _ in range(_NSLOTS)] for _ in range(_OB_NROWS)
+        ]
 
         def deliver(mb, acc, md, mc, valid_nb, type_v, fields):
             """Enqueue one candidate: fields are [B] rows in mb-row
-            order (type, sender, addr, value, second, sharers)."""
+            order (type, sender, addr, value, second, sharers).
+            Returns the accepted [N, B] mask as well."""
             pos = count2 + acc
-            hot = (iota_cap == pos[:, None, :]) & valid_nb[:, None, :]
+            accepted = valid_nb & (pos < cap)
+            hot = (iota_cap == pos[:, None, :]) & accepted[:, None, :]
             planes = []
             for frow in range(_NFIELD):
                 planes.append(
@@ -497,12 +541,18 @@ def build_cycle(config: SystemConfig, bb: int):
                               mb[:, frow, :, :])
                 )
             mb = jnp.stack(planes, axis=1)
-            dcount = jnp.sum(valid_nb.astype(I32), axis=0, keepdims=True)
+            dcount = jnp.sum(accepted.astype(I32), axis=0, keepdims=True)
             md = md + dcount
             mc = mc + jnp.where(iota_t == type_v[None, :], dcount, 0)
-            return mb, acc + valid_nb.astype(I32), md, mc
+            return mb, acc + accepted.astype(I32), md, mc, accepted
 
-        def point_candidate(mb, acc, md, mc, sl, sender):
+        def record_reject(k, sender, valid_b, recv_b, fields):
+            rej_valid[k][sender] = valid_b.astype(I32)
+            for frow in range(_NFIELD):
+                rej_rows[frow][k][sender] = fields[frow]
+            rej_rows[_OB_RECV][k][sender] = recv_b
+
+        def point_candidate(mb, acc, md, mc, sl, k, sender):
             valid_s = sl["valid"][sender]                  # [B]
             recv_s = sl["recv"][sender]
             valid_nb = valid_s[None, :] & (iota_n == recv_s[None, :])
@@ -515,37 +565,72 @@ def build_cycle(config: SystemConfig, bb: int):
                 sl["second"][sender],
                 sl["sharers"][sender],
             ]
-            return deliver(mb, acc, md, mc, valid_nb, type_v, fields)
+            mb, acc, md, mc, accepted = deliver(
+                mb, acc, md, mc, valid_nb, type_v, fields
+            )
+            rejected = valid_s & ~jnp.any(accepted, axis=0)
+            record_reject(k, sender, rejected, recv_s, fields)
+            return mb, acc, md, mc
 
         def inv_candidate(mb, acc, md, mc, sender):
             mask_s = inv_sharers[sender]                   # [B]
             valid_nb = ((mask_s[None, :] >> iota_n) & 1) == 1
             type_v = jnp.full((bb,), int(MsgType.INV), I32)
+            addr_s = inv_addr[sender]
             fields = [
                 type_v,
                 jnp.full((bb,), sender, I32),
-                inv_addr[sender],
+                addr_s,
                 jnp.zeros((bb,), I32),
                 jnp.full((bb,), -1, I32),
                 jnp.zeros((bb,), I32),
             ]
-            return deliver(mb, acc, md, mc, valid_nb, type_v, fields)
+            mb, acc, md, mc, accepted = deliver(
+                mb, acc, md, mc, valid_nb, type_v, fields
+            )
+            remaining = mask_s & ~jnp.sum(
+                accepted.astype(I32) << iota_n, axis=0
+            )
+            rej_valid[2][sender] = (remaining != 0).astype(I32)
+            for frow in range(_NFIELD):
+                rej_rows[frow][2][sender] = fields[frow]
+            rej_rows[_F_SHARERS][2][sender] = remaining
+            rej_rows[_F_ADDR][2][sender] = addr_s
+            rej_rows[_OB_RECV][2][sender] = jnp.full((bb,), -1, I32)
+            return mb, acc, md, mc
 
         md = msgs_delivered
         mc = mc_inc
         for sender in range(n):
-            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sA0, sender)
-            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sA1, sender)
+            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sA0, 0, sender)
+            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sA1, 1, sender)
             mb, acc, md, mc = inv_candidate(mb, acc, md, mc, sender)
         for sender in range(n):
-            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sB0, sender)
-            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sB1, sender)
+            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sB0, 3, sender)
+            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sB1, 4, sender)
+
+        ob_valid_new = jnp.stack(
+            [jnp.stack(rej_valid[k], axis=0) for k in range(_NSLOTS)], axis=1
+        )                                                  # [N, 5, B]
+        ob_new = jnp.stack(
+            [
+                jnp.stack(
+                    [jnp.stack(rej_rows[r][k], axis=0) for k in range(_NSLOTS)],
+                    axis=1,
+                )
+                for r in range(_OB_NROWS)
+            ],
+            axis=1,
+        )                                                  # [N, 7, 5, B]
+        blocked_next = jnp.sum(ob_valid_new, axis=1) > 0
 
         mb_count3 = count2 + acc
         overflow_now = jnp.any(mb_count3 > cap, axis=0, keepdims=True)
 
         # ===== phase D: dump-at-local-completion snapshots ===========
-        done_node = (pc >= tr_len) & ~waiting & (mb_count3 == 0)
+        done_node = (
+            (pc >= tr_len) & ~waiting & (mb_count3 == 0) & ~blocked_next
+        )
         snap_now = done_node & ~(s["snap_taken"] != 0)
         s2 = snap_now[:, None, :]
         snap_mem = jnp.where(s2, mem, s["snap_mem"])
@@ -586,6 +671,7 @@ def build_cycle(config: SystemConfig, bb: int):
             "mb": mb, "mb_count": mb_count3, "pc": pc,
             "waiting": waiting.astype(I32),
             "pending_write": pending_write,
+            "ob": ob_new, "ob_valid": ob_valid_new,
             "snap_taken": ((s["snap_taken"] != 0) | done_node).astype(I32),
             "snap_mem": snap_mem, "snap_dir_state": snap_dir_state,
             "snap_dir_sharers": snap_dir_sharers,
@@ -606,6 +692,7 @@ def quiescent_block(s) -> jnp.ndarray:
         jnp.all(s["pc"] >= s["tr_len"], axis=0)
         & jnp.all(s["waiting"] == 0, axis=0)
         & jnp.all(s["mb_count"] == 0, axis=0)
+        & jnp.all(s["ob_valid"] == 0, axis=(0, 1))
     )
 
 
@@ -639,6 +726,8 @@ def _init_transposed(config: SystemConfig, tr_op, tr_addr, tr_val, tr_len):
         "mb": mb0,
         "mb_count": z2.copy(), "pc": z2.copy(),
         "waiting": z2.copy(), "pending_write": z2.copy(),
+        "ob": np.zeros((n, _OB_NROWS, _NSLOTS, b), np.int32),
+        "ob_valid": np.zeros((n, _NSLOTS, b), np.int32),
         "snap_taken": z2.copy(),
         "snap_mem": mem0.copy(),
         "snap_dir_state": np.full((n, m, b), _DU, np.int32),
@@ -681,6 +770,7 @@ def _build_call(config: SystemConfig, b: int, bb: int, k: int,
         "mem": (n, m), "dir_state": (n, m), "dir_sharers": (n, m),
         "mb": (n, _NFIELD, cap), "mb_count": (n,), "pc": (n,),
         "waiting": (n,), "pending_write": (n,),
+        "ob": (n, _OB_NROWS, _NSLOTS), "ob_valid": (n, _NSLOTS),
         "snap_taken": (n,), "snap_mem": (n, m),
         "snap_dir_state": (n, m), "snap_dir_sharers": (n, m),
         "snap_cache_addr": (n, c), "snap_cache_val": (n, c),
@@ -806,7 +896,7 @@ class PallasEngine:
             calls += 1
             if bool(jnp.any(self.state["scalars"][_SC_OVERFLOW] > 0)):
                 raise StallError(
-                    "mailbox capacity exceeded; raise msg_buffer_size"
+                    "internal invariant violated: mailbox overflow despite backpressure"
                 )
             if bool(
                 jnp.all(

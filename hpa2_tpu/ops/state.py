@@ -69,6 +69,19 @@ class SimState(NamedTuple):
     pc: jnp.ndarray
     waiting: jnp.ndarray  # bool
     pending_write: jnp.ndarray
+    # deferred-send outbox (capacity backpressure): the candidate-grid
+    # slots [A0, A1, AINV, B0, B1] of this node's last action that did
+    # not fit their receiver's mailbox.  While any slot is valid the
+    # node is BLOCKED (no handle, no issue) — the lockstep analog of
+    # the reference's blocking enqueue (assignment.c:715-724).  Slot 2
+    # (AINV) keeps the *remaining* INV delivery mask in ob_sharers.
+    ob_valid: jnp.ndarray    # [N, 5] bool
+    ob_recv: jnp.ndarray     # [N, 5]
+    ob_type: jnp.ndarray     # [N, 5]
+    ob_addr: jnp.ndarray     # [N, 5]
+    ob_value: jnp.ndarray    # [N, 5]
+    ob_second: jnp.ndarray   # [N, 5]
+    ob_sharers: jnp.ndarray  # [N, 5, W] uint32
     # traces [N, T]
     tr_op: jnp.ndarray  # 0 = RD, 1 = WR
     tr_addr: jnp.ndarray
@@ -146,6 +159,13 @@ def init_state_batched(
         pc=zeros((b, n), I32),
         waiting=zeros((b, n), bool),
         pending_write=zeros((b, n), I32),
+        ob_valid=zeros((b, n, 5), bool),
+        ob_recv=zeros((b, n, 5), I32),
+        ob_type=full((b, n, 5), -1, I32),
+        ob_addr=zeros((b, n, 5), I32),
+        ob_value=zeros((b, n, 5), I32),
+        ob_second=full((b, n, 5), -1, I32),
+        ob_sharers=zeros((b, n, 5, w), U32),
         tr_op=jnp.asarray(tr_op, dtype=I32),
         tr_addr=jnp.asarray(tr_addr, dtype=I32),
         tr_val=jnp.asarray(tr_val, dtype=I32),
@@ -230,6 +250,13 @@ def init_state(
         pc=jnp.zeros((n,), dtype=I32),
         waiting=jnp.zeros((n,), dtype=bool),
         pending_write=jnp.zeros((n,), dtype=I32),
+        ob_valid=jnp.zeros((n, 5), dtype=bool),
+        ob_recv=jnp.zeros((n, 5), dtype=I32),
+        ob_type=jnp.full((n, 5), -1, dtype=I32),
+        ob_addr=jnp.zeros((n, 5), dtype=I32),
+        ob_value=jnp.zeros((n, 5), dtype=I32),
+        ob_second=jnp.full((n, 5), -1, dtype=I32),
+        ob_sharers=jnp.zeros((n, 5, w), dtype=U32),
         tr_op=jnp.asarray(tr_op),
         tr_addr=jnp.asarray(tr_addr),
         tr_val=jnp.asarray(tr_val),

@@ -208,11 +208,16 @@ def build_step(
                 jax.lax.axis_index(axis_name).astype(I32) * n_local
                 + local_ids
             )
+        # nodes with deferred sends are blocked: no handle, no issue —
+        # the lockstep analog of the reference's blocking enqueue
+        # (assignment.c:715-724; capacity backpressure, SURVEY.md §5)
+        blocked = jnp.any(st.ob_valid, axis=1)
+
         # ============== phase A: handle one message per node ==========
         # head is always slot 0 (shift-down queue): reads are static
         # slices — a fused gather would be scalarized by the TPU
         # backend (measured ~1000x slower than this formulation)
-        has_msg = st.mb_count > 0
+        has_msg = (st.mb_count > 0) & ~blocked
         hm = st.mb_data[:, 0, :]
         mt = jnp.where(has_msg, hm[:, MB_TYPE], _NO_MSG)
         snd = hm[:, MB_SENDER]
@@ -509,7 +514,7 @@ def build_step(
         )
 
         # ============== phase B: instruction issue ====================
-        elig = (mb_count2 == 0) & ~waiting & (st.pc < st.tr_len)
+        elig = (mb_count2 == 0) & ~waiting & ~blocked & (st.pc < st.tr_len)
         if replay:
             pos = jnp.minimum(st.order_pos, st.order_node.shape[0] - 1)
             cur = st.order_node[pos]
@@ -565,6 +570,32 @@ def build_step(
             order_pos = st.order_pos + jnp.any(elig).astype(I32)
         else:
             order_pos = st.order_pos
+
+        # merge deferred sends back into their candidate-grid slots:
+        # blocked nodes produced no new sends this cycle, so pending
+        # and new are exclusive per node and a where-merge is exact
+        def _merge_pending(slots, k):
+            pv = st.ob_valid[:, k]
+            slots.valid = slots.valid | pv
+            slots.recv = jnp.where(pv, st.ob_recv[:, k], slots.recv)
+            slots.type = jnp.where(pv, st.ob_type[:, k], slots.type)
+            slots.addr = jnp.where(pv, st.ob_addr[:, k], slots.addr)
+            slots.value = jnp.where(pv, st.ob_value[:, k], slots.value)
+            slots.second = jnp.where(pv, st.ob_second[:, k], slots.second)
+            slots.sharers = jnp.where(
+                pv[:, None], st.ob_sharers[:, k], slots.sharers
+            )
+
+        _merge_pending(sA0, 0)
+        _merge_pending(sA1, 1)
+        pend_inv = st.ob_valid[:, 2]
+        inv_valid = inv_valid | pend_inv
+        inv_sharers = jnp.where(
+            pend_inv[:, None], st.ob_sharers[:, 2], inv_sharers
+        )
+        inv_addr = jnp.where(pend_inv, st.ob_addr[:, 2], inv_addr)
+        _merge_pending(sB0, 3)
+        _merge_pending(sB1, 4)
 
         # ============== phase C: deterministic delivery ===============
         # candidate order per receiver: phase A (sender-major, slots
@@ -642,8 +673,17 @@ def build_step(
             point_valid[None, :] & (f["recv"][None, :] == node_ids[:, None])
         ) | inv_hit
 
+        # capacity backpressure: accept valid candidates in global
+        # order until the receiver's mailbox is full; the rest defer to
+        # the sender's outbox.  Acceptance is prefix-monotone per
+        # receiver (the queue only grows during delivery), so for every
+        # ACCEPTED candidate the exclusive prefix count of valid
+        # candidates equals the prefix count of accepted ones — offs
+        # stays the exact enqueue position.
         offs = jnp.cumsum(valid_rj.astype(I32), axis=1) - valid_rj.astype(I32)
-        delivered = jnp.sum(valid_rj.astype(I32), axis=1)
+        avail = jnp.maximum(cap - mb_count2, 0)
+        accept_rj = valid_rj & (offs < avail[:, None])
+        delivered = jnp.sum(accept_rj.astype(I32), axis=1)
 
         # TPU gathers/scatters fused into this graph get scalarized
         # (measured ms-scale); deliver instead by one-hot placement:
@@ -658,7 +698,7 @@ def build_step(
         )  # [J, F]
         pos = mb_count2[:, None] + offs                       # [N, J]
         slot = jnp.arange(cap, dtype=I32)
-        hot = valid_rj[:, None, :] & (pos[:, None, :] == slot[None, :, None])
+        hot = accept_rj[:, None, :] & (pos[:, None, :] == slot[None, :, None])
         # lower the placement to an MXU matmul: split each int32 field
         # into 4 byte planes (exact in bf16 — every product is
         # one-hot x byte, and at most one candidate is hot per slot so
@@ -686,6 +726,83 @@ def build_step(
         mb_data = jnp.where(write[:, :, None], placed, qdata)
         mb_count3 = mb_count2 + delivered
         ov_now = jnp.any(mb_count3 > cap)
+
+        # -- deferred-send outbox update ------------------------------
+        # a point candidate has exactly one receiver, so "accepted" is
+        # one reduction over receivers (psum'd across shards: the
+        # receiver may live elsewhere)
+        acc_j = jnp.sum(accept_rj.astype(I32), axis=0)        # [J]
+        if axis_name is not None:
+            acc_j = jax.lax.psum(acc_j, axis_name)
+        rejected_pt = point_valid & (acc_j == 0)
+        # inv fan-out: pack the accepted receiver bits of every inv
+        # candidate (phase-A slot 2, global sender s at column 3s) back
+        # into per-sender sharer words; bits from different shards
+        # never collide, so an int32 psum is an exact OR
+        inv_acc = accept_rj[:, : 3 * n][:, 2::3]              # [Nl, n]
+        shifted = jax.lax.bitcast_convert_type(
+            inv_acc.astype(U32) << (node_ids % 32).astype(U32)[:, None], I32
+        )
+        word_sel = (node_ids // 32)[None, :] == jnp.arange(w, dtype=I32)[:, None]
+        done_bits = jnp.sum(
+            jnp.where(word_sel[:, :, None], shifted[None, :, :], 0), axis=1
+        )                                                     # [W, n]
+        if axis_name is not None:
+            done_bits = jax.lax.psum(done_bits, axis_name)
+        delivered_inv = jax.lax.bitcast_convert_type(
+            done_bits.T, U32
+        )                                                     # [n, W]
+
+        # slice the local senders' grid region (global sender g0..)
+        if axis_name is None:
+            g0 = 0
+            take = lambda arr, start, size: arr[start : start + size]
+        else:
+            g0 = jax.lax.axis_index(axis_name).astype(I32) * n_local
+            take = lambda arr, start, size: jax.lax.dynamic_slice_in_dim(
+                arr, start, size, 0
+            )
+        rejA = take(rejected_pt, 3 * g0, 3 * n_local).reshape(n_local, 3)
+        rejB = take(
+            rejected_pt, 3 * n + 2 * g0, 2 * n_local
+        ).reshape(n_local, 2)
+        rem_inv = inv_sharers & ~take(delivered_inv, g0, n_local)
+        ob_valid = jnp.stack(
+            [
+                rejA[:, 0],
+                rejA[:, 1],
+                jnp.any(rem_inv != 0, axis=1),
+                rejB[:, 0],
+                rejB[:, 1],
+            ],
+            axis=1,
+        )
+
+        def _ob_field(name):
+            arr = f[name]
+            fa_l = take(arr, 3 * g0, 3 * n_local).reshape(n_local, 3)
+            fb_l = take(arr, 3 * n + 2 * g0, 2 * n_local).reshape(n_local, 2)
+            return jnp.concatenate([fa_l, fb_l], axis=1)      # [Nl, 5]
+
+        ob_recv = _ob_field("recv")
+        ob_type = _ob_field("type")
+        ob_addr = _ob_field("addr")
+        ob_value = _ob_field("value")
+        ob_second = _ob_field("second")
+        sh_l = jnp.concatenate(
+            [
+                take(f["sharers"], 3 * g0, 3 * n_local).reshape(n_local, 3, w),
+                take(f["sharers"], 3 * n + 2 * g0, 2 * n_local).reshape(
+                    n_local, 2, w
+                ),
+            ],
+            axis=1,
+        )                                                     # [Nl, 5, W]
+        slot_is_inv = jnp.arange(5, dtype=I32) == 2
+        ob_sharers = jnp.where(
+            slot_is_inv[None, :, None], rem_inv[:, None, :], sh_l
+        )
+        blocked_next = jnp.any(ob_valid, axis=1)
         instr_inc = jnp.sum(elig.astype(I32))
         msgs_inc = jnp.sum(delivered)
         # observability counters (names match spec_engine.counters)
@@ -698,7 +815,7 @@ def build_step(
         inv_inc = cnt(inv_applied)
         # sends by transaction type: fan-out count per candidate
         # (receivers holding it valid), bucketed by the type column
-        cand_cnt = jnp.sum(valid_rj.astype(I32), axis=0)  # [J]
+        cand_cnt = jnp.sum(accept_rj.astype(I32), axis=0)  # [J]
         type_ids = jnp.arange(len(MsgType), dtype=I32)
         mc_inc = jnp.sum(
             jnp.where(
@@ -723,7 +840,9 @@ def build_step(
         overflow = st.overflow | ov_now
 
         # ============== phase D: dump-at-local-completion =============
-        done_node = (pc >= st.tr_len) & ~waiting & (mb_count3 == 0)
+        done_node = (
+            (pc >= st.tr_len) & ~waiting & (mb_count3 == 0) & ~blocked_next
+        )
         snap_now = done_node & ~st.snap_taken
         s2 = snap_now[:, None]
         s3 = snap_now[:, None, None]
@@ -746,6 +865,13 @@ def build_step(
             pc=pc,
             waiting=waiting,
             pending_write=pending_write,
+            ob_valid=ob_valid,
+            ob_recv=ob_recv,
+            ob_type=ob_type,
+            ob_addr=ob_addr,
+            ob_value=ob_value,
+            ob_second=ob_second,
+            ob_sharers=ob_sharers,
             tr_op=st.tr_op,
             tr_addr=st.tr_addr,
             tr_val=st.tr_val,
@@ -784,6 +910,7 @@ def quiescent(st: SimState) -> jnp.ndarray:
         jnp.all(st.pc >= st.tr_len)
         & jnp.all(~st.waiting)
         & jnp.all(st.mb_count == 0)
+        & jnp.all(~st.ob_valid)
     )
     replay_done = (st.order_len < 0) | (st.order_pos >= st.order_len)
     return done & replay_done

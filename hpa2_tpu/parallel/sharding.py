@@ -206,7 +206,7 @@ class NodeShardedEngine:
         st = jax.tree_util.tree_map(lambda x: x.block_until_ready(), st)
         self.state = st
         if bool(st.overflow):
-            raise StallError("mailbox capacity exceeded; raise msg_buffer_size")
+            raise StallError("internal invariant violated: mailbox overflow despite backpressure")
         if not bool(quiescent(st)):
             raise StallError(
                 f"no quiescence after {int(st.cycle)} cycles (livelock?)"
@@ -281,7 +281,7 @@ class GridEngine:
         st = jax.tree_util.tree_map(lambda x: x.block_until_ready(), st)
         self.state = st
         if bool(jnp.any(st.overflow)):
-            raise StallError("mailbox capacity exceeded in batch")
+            raise StallError("internal invariant violated: mailbox overflow despite backpressure")
         if not bool(jnp.all(jax.vmap(quiescent)(st))):
             raise StallError("batch did not reach quiescence (livelock?)")
         return self

@@ -1,0 +1,107 @@
+"""Checkpoint / resume for simulator state.
+
+The reference has no persistence at all (its only artifact is the
+write-only ``printProcessorState`` dump, assignment.c:824-875 —
+SURVEY.md §5 "checkpoint/resume: none").  Long benchmark runs on the
+flaky TPU tunnel need one: ``SimState`` is a NamedTuple of arrays, so
+a checkpoint is a single compressed ``.npz`` holding every leaf plus
+the ``SystemConfig`` (JSON) that shaped them.  Works for single-system
+and batched (leading ensemble axis) states alike — shapes carry the
+difference.
+
+Resume contract: ``load_state`` rebuilds a state tree that continues
+bit-identically (tests/test_checkpoint.py gates interrupted-vs-straight
+equality).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.ops.state import SimState
+
+_MAGIC = "hpa2_checkpoint_v1"
+
+
+def _config_to_json(config: SystemConfig) -> str:
+    d = dataclasses.asdict(config)
+    return json.dumps(d)
+
+
+def _config_from_json(text: str) -> SystemConfig:
+    d = json.loads(text)
+    d["semantics"] = Semantics(**d["semantics"])
+    return SystemConfig(**d)
+
+
+def save_state(
+    path: str,
+    state: SimState,
+    config: SystemConfig,
+    extra_meta: Optional[dict] = None,
+) -> None:
+    """Atomically write state + config (+ JSON-able workload metadata,
+    e.g. batch/seed — checked on resume so a stale checkpoint from a
+    different run can't be silently continued) to ``path`` (.npz)."""
+    arrays = {
+        f"f_{name}": np.asarray(leaf)
+        for name, leaf in zip(SimState._fields, state)
+    }
+    arrays["meta_magic"] = np.array(_MAGIC)
+    arrays["meta_config"] = np.array(_config_to_json(config))
+    arrays["meta_extra"] = np.array(json.dumps(extra_meta or {}))
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)  # atomic: a crash never leaves a torn file
+
+
+def load_state(path: str, with_meta: bool = False):
+    """-> (state, config) or, with ``with_meta``, (state, config,
+    extra_meta dict)."""
+    with np.load(path) as z:
+        if str(z["meta_magic"]) != _MAGIC:
+            raise ValueError(f"{path}: not a hpa2 checkpoint")
+        config = _config_from_json(str(z["meta_config"]))
+        extra = json.loads(str(z["meta_extra"])) if "meta_extra" in z else {}
+        leaves = []
+        for name in SimState._fields:
+            key = f"f_{name}"
+            if key not in z:
+                raise ValueError(
+                    f"{path}: missing field {name} (incompatible "
+                    "checkpoint version)"
+                )
+            leaves.append(jnp.asarray(z[key]))
+    state = SimState(*leaves)
+    if with_meta:
+        return state, config, extra
+    return state, config
+
+
+def latest_checkpoint(directory: str, stem: str = "ckpt") -> Optional[str]:
+    """Highest-numbered ``<stem>_<n>.npz`` in ``directory`` (or None)."""
+    best, best_n = None, -1
+    if not os.path.isdir(directory):
+        return None
+    for name in os.listdir(directory):
+        if not (name.startswith(stem + "_") and name.endswith(".npz")):
+            continue
+        try:
+            n = int(name[len(stem) + 1 : -4])
+        except ValueError:
+            continue
+        if n > best_n:
+            best, best_n = os.path.join(directory, name), n
+    return best

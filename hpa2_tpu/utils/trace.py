@@ -123,6 +123,17 @@ def load_instruction_order(path: str) -> List[IssueRecord]:
         return parse_instruction_order(f.read())
 
 
+def format_instruction_order(records: Sequence[IssueRecord]) -> str:
+    """Inverse of :func:`parse_instruction_order` — the DEBUG_INSTR
+    line format (assignment.c:596-597) used by every shipped
+    ``instruction_order.txt`` fixture."""
+    return "".join(
+        f"Processor {r.proc}: instr type={r.op}, "
+        f"address=0x{r.address:02X}, value={r.value}\n"
+        for r in records
+    )
+
+
 def validate_order_against_traces(
     order: Sequence[IssueRecord], traces: Sequence[Sequence[Instr]]
 ) -> None:

@@ -32,11 +32,14 @@ static void set_err(Hpa2Result* r, const std::string& e) {
 
 // Run a trace directory; writes core_<n>_output.txt into out_dir.
 // mode: 0 = lockstep, 1 = omp.  replay_path may be NULL.
+// record_order_path (may be NULL/empty): write the executed issue
+// interleaving there in DEBUG_INSTR format (assignment.c:596-597).
 int hpa2_run_dir(const char* trace_dir, const char* out_dir, int mode,
                  int nodes, int cache, int mem, int cap, int max_instr,
                  int robust, const char* replay_path, int candidates,
                  int final_dump, unsigned long long max_cycles,
-                 int threads, Hpa2Result* result) {
+                 int threads, const char* record_order_path,
+                 Hpa2Result* result) {
   Config cfg;
   cfg.nodes = nodes;
   cfg.cache = cache;
@@ -54,9 +57,10 @@ int hpa2_run_dir(const char* trace_dir, const char* out_dir, int mode,
       order_p = &order;
       mode = 0;
     }
+    bool record = record_order_path && *record_order_path;
     auto t0 = std::chrono::steady_clock::now();
     RunResult res = (mode == 1)
-                        ? run_omp(cfg, traces, threads)
+                        ? run_omp(cfg, traces, threads, record)
                         : run_lockstep(cfg, traces, order_p, max_cycles,
                                        candidates != 0);
     auto t1 = std::chrono::steady_clock::now();
@@ -64,6 +68,10 @@ int hpa2_run_dir(const char* trace_dir, const char* out_dir, int mode,
     if (!res.error.empty()) {
       set_err(result, res.error);
       return 1;
+    }
+    if (record) {
+      std::ofstream rf(record_order_path);
+      rf << format_instruction_order(res.issue_order);
     }
     const auto& dumps = final_dump ? res.finals : res.snapshots;
     for (int n = 0; n < cfg.nodes; ++n) {

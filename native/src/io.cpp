@@ -91,6 +91,18 @@ std::vector<IssueRecord> load_instruction_order(const std::string& path) {
   return out;
 }
 
+std::string format_instruction_order(const std::vector<IssueRecord>& recs) {
+  std::string out;
+  char buf[96];
+  for (const auto& r : recs) {
+    snprintf(buf, sizeof buf,
+             "Processor %d: instr type=%c, address=0x%02X, value=%d\n",
+             r.proc, r.write ? 'W' : 'R', (unsigned)r.addr, r.value);
+    out += buf;
+  }
+  return out;
+}
+
 static std::string binary8(Sharers s) {
   if (s >> 8)
     throw std::runtime_error(

@@ -9,6 +9,9 @@
 //   --nodes N --cache C --mem M --cap K --max-instr I
 //   --robust              NACK stale interventions (heals livelocks)
 //   --replay FILE         lockstep replay of an instruction_order.txt
+//   --record-order FILE   write the executed issue interleaving in
+//                         DEBUG_INSTR format (mints new fixture
+//                         run-sets; the record->replay->verify loop)
 //   --candidates          also write every legal dump timing per node
 //   --final               dump quiescent state instead of
 //                         dump-at-local-completion snapshots
@@ -39,7 +42,7 @@ static void write_file(const std::string& path, const std::string& text) {
 int main(int argc, char** argv) {
   Config cfg;
   std::string mode = "lockstep";
-  std::string trace_dir, replay_path, out_dir = ".";
+  std::string trace_dir, replay_path, record_path, out_dir = ".";
   bool candidates = false, final_dump = false, json = false;
   int bench_instrs = 0, threads = 0;
   uint64_t seed = 0, max_cycles = 100'000'000ull;
@@ -61,6 +64,7 @@ int main(int argc, char** argv) {
     else if (a == "--max-instr") cfg.max_instr = std::stoi(next());
     else if (a == "--robust") cfg.nack = true;
     else if (a == "--replay") replay_path = next();
+    else if (a == "--record-order") record_path = next();
     else if (a == "--candidates") candidates = true;
     else if (a == "--final") final_dump = true;
     else if (a == "--out") out_dir = next();
@@ -103,7 +107,8 @@ int main(int argc, char** argv) {
 
     auto t0 = std::chrono::steady_clock::now();
     RunResult res = (mode == "omp")
-                        ? run_omp(cfg, traces, threads)
+                        ? run_omp(cfg, traces, threads,
+                                  !record_path.empty())
                         : run_lockstep(cfg, traces, order_p, max_cycles,
                                        candidates);
     auto t1 = std::chrono::steady_clock::now();
@@ -113,6 +118,9 @@ int main(int argc, char** argv) {
       std::cerr << "error: " << res.error << "\n";
       return 1;
     }
+
+    if (!record_path.empty())
+      write_file(record_path, format_instruction_order(res.issue_order));
 
     if (bench_instrs == 0) {
       const auto& dumps = final_dump ? res.finals : res.snapshots;

@@ -456,11 +456,23 @@ RunResult run_lockstep(const Config& cfg,
   std::vector<bool> dumped(N, false);
 
   size_t order_pos = 0;
-  std::vector<std::pair<int, Msg>> outbox;  // (receiver, msg)
+  // send candidate: phase (0=handle, 1=issue) + sender for the global
+  // deterministic delivery order; rejected candidates defer to the
+  // sender's pending list (capacity backpressure — the lockstep analog
+  // of the reference's blocking enqueue, assignment.c:715-724)
+  struct Cand {
+    int phase;
+    int sender;
+    int recv;
+    Msg m;
+  };
+  std::vector<Cand> outbox;
+  std::vector<std::vector<Cand>> pending(N);
 
   auto quiescent = [&]() {
     for (int i = 0; i < N; ++i)
-      if (!nodes[i].trace_done() || nodes[i].waiting || !mailbox[i].empty())
+      if (!nodes[i].trace_done() || nodes[i].waiting ||
+          !mailbox[i].empty() || !pending[i].empty())
         return false;
     if (replay && order_pos < replay->size()) return false;
     return true;
@@ -481,13 +493,15 @@ RunResult run_lockstep(const Config& cfg,
     bool progress = false;
     std::vector<bool> handled(N, false);
 
-    // 1. handle one message per node
+    // 1. handle one message per node (nodes with deferred sends are
+    // blocked, like a reference thread stuck inside sendMessage)
     for (int i = 0; i < N; ++i) {
-      if (mailbox[i].empty()) continue;
+      if (mailbox[i].empty() || !pending[i].empty()) continue;
       Msg m = mailbox[i].front();
       mailbox[i].pop_front();
-      handle_msg(cfg, i, nodes[i], m,
-                 [&](int recv, const Msg& mm) { outbox.emplace_back(recv, mm); });
+      handle_msg(cfg, i, nodes[i], m, [&](int recv, const Msg& mm) {
+        outbox.push_back(Cand{0, i, recv, mm});
+      });
       handled[i] = true;
       progress = true;
     }
@@ -497,14 +511,17 @@ RunResult run_lockstep(const Config& cfg,
       if (order_pos < replay->size()) {
         const IssueRecord& rec = (*replay)[order_pos];
         NodeState& nd = nodes[rec.proc];
-        if (mailbox[rec.proc].empty() && !nd.waiting && !nd.trace_done()) {
+        if (mailbox[rec.proc].empty() && pending[rec.proc].empty() &&
+            !nd.waiting && !nd.trace_done()) {
           const Instr& nxt = (*nd.trace)[nd.pc];
           if (nxt.write != rec.write || nxt.addr != rec.addr) {
             res.error = "replay order mismatch";
             return res;
           }
+          res.issue_order.push_back(
+              {rec.proc, nxt.write, nxt.addr, nxt.value});
           issue_one(cfg, rec.proc, nd, [&](int recv, const Msg& mm) {
-            outbox.emplace_back(recv, mm);
+            outbox.push_back(Cand{1, rec.proc, recv, mm});
           });
           res.counters.instructions++;
           order_pos++;
@@ -514,9 +531,12 @@ RunResult run_lockstep(const Config& cfg,
     } else {
       for (int i = 0; i < N; ++i) {
         NodeState& nd = nodes[i];
-        if (mailbox[i].empty() && !nd.waiting && !nd.trace_done()) {
+        if (mailbox[i].empty() && pending[i].empty() && !nd.waiting &&
+            !nd.trace_done()) {
+          const Instr& nxt = (*nd.trace)[nd.pc];
+          res.issue_order.push_back({i, nxt.write, nxt.addr, nxt.value});
           issue_one(cfg, i, nd, [&](int recv, const Msg& mm) {
-            outbox.emplace_back(recv, mm);
+            outbox.push_back(Cand{1, i, recv, mm});
           });
           res.counters.instructions++;
           progress = true;
@@ -524,17 +544,39 @@ RunResult run_lockstep(const Config& cfg,
       }
     }
 
-    // 3. deliver (already in (phase, sender, emission) order)
-    for (auto& [recv, mm] : outbox) {
-      mailbox[recv].push_back(mm);
-      res.counters.messages++;
+    // 3. deliver with capacity backpressure: pending (deferred) sends
+    // at their original (phase, sender) positions, then this cycle's
+    // new sends; accepted while the receiver has space, the rest kept
+    // on the sender (blocked nodes don't act, so a node never has both
+    // pending and new candidates)
+    {
+      std::vector<Cand> merged;
+      for (int i = 0; i < N; ++i) {
+        for (auto& c : pending[i]) merged.push_back(c);
+        pending[i].clear();
+      }
+      for (auto& c : outbox) merged.push_back(c);
+      outbox.clear();
+      std::stable_sort(merged.begin(), merged.end(),
+                       [](const Cand& a, const Cand& b) {
+                         return a.phase != b.phase ? a.phase < b.phase
+                                                   : a.sender < b.sender;
+                       });
+      for (auto& c : merged) {
+        if ((int)mailbox[c.recv].size() < cfg.cap) {
+          mailbox[c.recv].push_back(c.m);
+          res.counters.messages++;
+          progress = true;
+        } else {
+          pending[c.sender].push_back(c);
+        }
+      }
     }
-    outbox.clear();
 
     // 4. dump-at-local-completion (+ candidate capture)
     for (int i = 0; i < N; ++i) {
       NodeState& nd = nodes[i];
-      if (nd.trace_done() && !nd.waiting) {
+      if (nd.trace_done() && !nd.waiting && pending[i].empty()) {
         if (!dumped[i]) {
           if (mailbox[i].empty()) {
             dumped[i] = true;
@@ -582,7 +624,7 @@ struct RingBox {
 
 RunResult run_omp(const Config& cfg,
                   const std::vector<std::vector<Instr>>& traces,
-                  int num_threads) {
+                  int num_threads, bool record_order) {
   RunResult res;
   const int N = cfg.nodes;
   if (num_threads <= 0) num_threads = N;
@@ -601,16 +643,33 @@ RunResult run_omp(const Config& cfg,
   std::atomic<long> inflight{0};
   std::atomic<int> undone{N};
   std::atomic<uint64_t> instr_total{0};
+  // issue-interleaving record (the DEBUG_INSTR log, assignment.c:
+  // 596-597): each issue reserves the next slot with one fetch_add —
+  // the linearization the record/replay workflow validates against
+  size_t total_instrs = 0;
+  if (record_order)
+    for (auto& t : traces) total_instrs += t.size();
+  std::vector<IssueRecord> order_buf(total_instrs);
+  std::atomic<uint64_t> issue_seq{0};
   std::atomic<bool> aborted{false};  // livelock watchdog (the
   // reference spins forever on this class; SURVEY.md §6.3)
 
   auto send = [&](int recv, const Msg& m) {
     inflight.fetch_add(1, std::memory_order_relaxed);
+    uint64_t spins = 0;
     for (;;) {
       omp_set_lock(&box[recv].lock);
       if (box[recv].count < cfg.cap) break;
       omp_unset_lock(&box[recv].lock);  // full: yield and retry (the
       // reference busy-waits with usleep, c:715-724)
+      // watchdog: with tiny capacities blocked senders can deadlock
+      // cyclically (the reference would spin forever here)
+      if (++spins > 2'000'000ull)
+        aborted.store(true, std::memory_order_relaxed);
+      if (aborted.load(std::memory_order_relaxed)) {
+        inflight.fetch_sub(1, std::memory_order_relaxed);
+        return;  // run is aborting; message intentionally dropped
+      }
       sched_yield();
     }
     box[recv].ring[box[recv].tail] = m;
@@ -665,6 +724,13 @@ RunResult run_omp(const Config& cfg,
 
         if (!nd.waiting) {
           if (!nd.trace_done()) {
+            if (record_order) {
+              const Instr& nxt = (*nd.trace)[nd.pc];
+              uint64_t slot =
+                  issue_seq.fetch_add(1, std::memory_order_relaxed);
+              order_buf[slot] =
+                  IssueRecord{i, nxt.write, nxt.addr, nxt.value};
+            }
             issue_one(cfg, i, nd, csend);
             ++my_instrs;
             progressed = true;
@@ -690,7 +756,7 @@ RunResult run_omp(const Config& cfg,
       } else {
         // idle: let peers run (critical when oversubscribed) and
         // watchdog the reference's livelock class (SURVEY.md §6.3)
-        if (++idle_spins > 20'000'000ull) {
+        if (++idle_spins > 2'000'000ull) {
           aborted.store(true, std::memory_order_relaxed);
           break;
         }
@@ -702,6 +768,9 @@ RunResult run_omp(const Config& cfg,
   }
 
   for (int i = 0; i < N; ++i) omp_destroy_lock(&box[i].lock);
+  if (record_order)
+    res.issue_order.assign(order_buf.begin(),
+                           order_buf.begin() + issue_seq.load());
   res.counters.instructions = instr_total.load();
   res.counters.messages = msg_total.load();
   for (int i = 0; i < N; ++i) res.finals.push_back(nodes[i].dump());

@@ -127,6 +127,10 @@ struct IssueRecord {
 std::vector<std::vector<Instr>> load_trace_dir(const Config& cfg,
                                                const std::string& dir);
 std::vector<IssueRecord> load_instruction_order(const std::string& path);
+// DEBUG_INSTR line format (assignment.c:596-597) — inverse of
+// load_instruction_order; how the reference's shipped fixture
+// interleavings were recorded.
+std::string format_instruction_order(const std::vector<IssueRecord>& recs);
 std::string format_dump(const Config& cfg, int proc, const NodeDump& d);
 
 // ---- engines --------------------------------------------------------
@@ -134,6 +138,11 @@ struct RunResult {
   std::vector<NodeDump> snapshots;               // dump-at-local-completion
   std::vector<NodeDump> finals;                  // quiescent state
   std::vector<std::vector<NodeDump>> candidates; // legal dump timings
+  // the executed issue interleaving, in DEBUG_INSTR order — replaying
+  // it on a lockstep engine validates a free run and mints new fixture
+  // run-sets (the reference's record->replay->verify workflow,
+  // SURVEY.md §4)
+  std::vector<IssueRecord> issue_order;
   Counters counters;
   bool completed = false;   // reached quiescence
   std::string error;
@@ -147,7 +156,10 @@ RunResult run_lockstep(const Config& cfg,
 
 RunResult run_omp(const Config& cfg,
                   const std::vector<std::vector<Instr>>& traces,
-                  int num_threads /* 0 = one per node */);
+                  int num_threads /* 0 = one per node */,
+                  bool record_order = false /* fill issue_order; off by
+                  default: the per-issue atomic would contend in the
+                  benchmark hot loop */);
 
 // synthetic workloads for benchmarking (LCG-based, deterministic)
 std::vector<std::vector<Instr>> gen_uniform_random(const Config& cfg,

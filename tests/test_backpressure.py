@@ -1,0 +1,207 @@
+"""Deferred-send backpressure (capacity) differential tests.
+
+The reference blocks a sender inside ``sendMessage`` when the
+receiver's 256-deep ring is full (assignment.c:715-724, busy-wait).
+The lockstep analog implemented by every engine: a node whose sends do
+not fit keeps them in a per-node outbox, is blocked (neither handles
+nor issues) until all of them drain, and delivery accepts candidates
+in the global deterministic (phase, sender, slot) order up to each
+receiver's free capacity (SURVEY.md §5 "masked/deferred-send
+mechanism instead of blocking").
+
+These tests run every engine at ``msg_buffer_size=4`` — small enough
+that random and bursty traffic constantly saturates mailboxes — and
+check bit-identical end state across engines plus bounded queues.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.models.protocol import Instr
+from hpa2_tpu.models.spec_engine import SpecEngine
+from hpa2_tpu.utils.trace import gen_uniform_random
+
+TINY = dict(num_procs=8, msg_buffer_size=4, semantics=Semantics().robust())
+
+
+def tiny_config(**kw):
+    return SystemConfig(**{**TINY, **kw})
+
+
+def bursty_traces(n=8, per_core=30):
+    """Everyone hammers node 0's home blocks: worst-case fan-in."""
+    return [
+        [Instr("W", (i % 4), i + j) for j in range(per_core)]
+        for i in range(n)
+    ]
+
+
+def _dicts(dumps):
+    return [d.__dict__ for d in dumps]
+
+
+# ---------------------------------------------------------------------------
+# spec engine semantics
+# ---------------------------------------------------------------------------
+
+def test_spec_bounded_queues_bursty():
+    cfg = tiny_config(max_instr_num=0)
+    eng = SpecEngine(cfg, bursty_traces())
+    eng.run(max_cycles=100_000)
+    assert eng.instructions == 8 * 30
+    assert eng.max_mailbox_depth <= cfg.msg_buffer_size
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_spec_bounded_queues_uniform(seed):
+    cfg = tiny_config()
+    eng = SpecEngine(cfg, gen_uniform_random(cfg, 32, seed=seed))
+    eng.run(max_cycles=100_000)
+    assert eng.max_mailbox_depth <= cfg.msg_buffer_size
+
+
+# ---------------------------------------------------------------------------
+# JAX engine differential
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_jax_matches_spec_tiny_cap(seed):
+    from hpa2_tpu.ops.engine import JaxEngine
+
+    cfg = tiny_config()
+    traces = gen_uniform_random(cfg, 24, seed=seed)
+    spec = SpecEngine(cfg, traces)
+    spec.run(max_cycles=100_000)
+    jx = JaxEngine(cfg, traces, max_cycles=100_000).run()
+    assert _dicts(spec.final_dumps()) == _dicts(jx.final_dumps())
+    assert _dicts(spec.snapshots()) == _dicts(jx.snapshots())
+    assert spec.cycle == jx.cycle
+    assert spec.messages == jx.messages
+
+
+def test_jax_matches_spec_bursty():
+    from hpa2_tpu.ops.engine import JaxEngine
+
+    cfg = tiny_config(max_instr_num=0)
+    traces = bursty_traces()
+    spec = SpecEngine(cfg, traces)
+    spec.run(max_cycles=100_000)
+    jx = JaxEngine(cfg, traces, max_cycles=100_000).run()
+    assert _dicts(spec.final_dumps()) == _dicts(jx.final_dumps())
+    assert spec.cycle == jx.cycle
+
+
+# ---------------------------------------------------------------------------
+# sharded JAX engine differential (node axis over the CPU mesh)
+# ---------------------------------------------------------------------------
+
+def test_node_sharded_matches_spec_tiny_cap():
+    import jax
+
+    from hpa2_tpu.parallel.sharding import NodeShardedEngine, make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    cfg = tiny_config()
+    traces = gen_uniform_random(cfg, 16, seed=3)
+    spec = SpecEngine(cfg, traces)
+    spec.run(max_cycles=100_000)
+    eng = NodeShardedEngine(
+        cfg, traces, mesh=make_mesh(node_shards=2), max_cycles=100_000
+    ).run()
+    assert _dicts(spec.final_dumps()) == _dicts(eng.final_dumps())
+    assert spec.cycle == eng.cycle
+
+
+# ---------------------------------------------------------------------------
+# pallas engine differential (interpret mode on CPU)
+# ---------------------------------------------------------------------------
+
+def test_pallas_matches_spec_tiny_cap():
+    from hpa2_tpu.ops.pallas_engine import PallasEngine
+    from hpa2_tpu.utils.trace import traces_to_arrays
+
+    cfg = tiny_config()
+    batch_traces = [gen_uniform_random(cfg, 16, seed=s) for s in (4, 5)]
+    arrays = traces_to_arrays(cfg, batch_traces)
+    pe = PallasEngine(
+        cfg, *arrays, block=2, cycles_per_call=32, interpret=True
+    ).run(max_cycles=100_000)
+    for b, traces in enumerate(batch_traces):
+        spec = SpecEngine(cfg, traces)
+        spec.run(max_cycles=100_000)
+        assert _dicts(spec.final_dumps()) == _dicts(
+            pe.system_final_dumps(b)
+        ), f"system {b}"
+        assert _dicts(spec.snapshots()) == _dicts(
+            pe.system_snapshots(b)
+        ), f"system {b}"
+
+
+# ---------------------------------------------------------------------------
+# native lockstep differential + free-running completion
+# ---------------------------------------------------------------------------
+
+def _write_traces(traces, dirpath):
+    os.makedirs(dirpath, exist_ok=True)
+    for n, tr in enumerate(traces):
+        with open(os.path.join(dirpath, f"core_{n}.txt"), "w") as f:
+            for ins in tr:
+                if ins.op == "R":
+                    f.write(f"RD 0x{ins.address:02X}\n")
+                else:
+                    f.write(f"WR 0x{ins.address:02X} {ins.value}\n")
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_native_lockstep_matches_spec_tiny_cap(tmp_path, seed):
+    from hpa2_tpu import native
+    from hpa2_tpu.utils.dump import format_processor_state, parse_processor_dump
+
+    native.ensure_built()
+    cfg = tiny_config()
+    traces = gen_uniform_random(cfg, 24, seed=seed)
+    tdir = tmp_path / "traces"
+    _write_traces(traces, str(tdir))
+    out = tmp_path / "out"
+    out.mkdir()
+    res = native.run_trace_dir(
+        cfg, str(tdir), str(out), mode="lockstep", max_cycles=100_000
+    )
+    assert res.ok
+    spec = SpecEngine(cfg, traces)
+    spec.run(max_cycles=100_000)
+    for i, dump in enumerate(spec.snapshots()):
+        want = format_processor_state(dump, cfg)
+        got = (out / f"core_{i}_output.txt").read_text()
+        assert got == want, f"core_{i}"
+
+
+def test_native_free_running_tiny_cap_never_hangs(tmp_path):
+    """The free-running engine blocks on full rings like the reference
+    (assignment.c:715-724).  With tiny rings, cyclically blocked
+    senders CAN deadlock — the reference would spin forever; our
+    contract is bounded time: either the run completes, or the
+    watchdog aborts it with a diagnostic.  (Deterministic completion
+    under tiny caps is the lockstep engines' guarantee, tested
+    above.)"""
+    from hpa2_tpu import native
+
+    native.ensure_built()
+    cfg = tiny_config(max_instr_num=0)  # uncapped trace load
+    traces = gen_uniform_random(cfg, 32, seed=7)
+    tdir = tmp_path / "traces"
+    _write_traces(traces, str(tdir))
+    out = tmp_path / "out"
+    out.mkdir()
+    try:
+        res = native.run_trace_dir(
+            cfg, str(tdir), str(out), mode="omp", max_cycles=100_000
+        )
+        assert res.ok
+        assert res.instructions == 8 * 32
+    except native.NativeError as e:
+        assert "watchdog" in str(e) or "livelock" in str(e)

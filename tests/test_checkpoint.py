@@ -1,0 +1,151 @@
+"""Checkpoint / resume (SURVEY.md §5: the reference has none).
+
+Gates: (1) save/load round-trips every SimState leaf bit-exactly;
+(2) an interrupted run resumed from a checkpoint finishes in exactly
+the state a straight run reaches; (3) the bench CLI's
+--checkpoint-every path writes checkpoints and resumes from them.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.ops.engine import (
+    build_batched_run,
+    build_batched_run_chunk,
+)
+from hpa2_tpu.ops.state import SimState, init_state_batched
+from hpa2_tpu.ops.step import quiescent
+from hpa2_tpu.utils.checkpoint import (
+    latest_checkpoint,
+    load_state,
+    save_state,
+)
+from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+CFG = SystemConfig(num_procs=4, semantics=Semantics().robust())
+
+
+def _state(batch=3, instrs=24, seed=0):
+    return init_state_batched(
+        CFG, *gen_uniform_random_arrays(CFG, batch, instrs, seed=seed)
+    )
+
+
+def _trees_equal(a: SimState, b: SimState):
+    for name, la, lb in zip(SimState._fields, a, b):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), name
+
+
+def test_save_load_round_trip(tmp_path):
+    st = _state()
+    # advance a little so non-initial values are exercised
+    st = build_batched_run_chunk(CFG, 7)(st)
+    path = str(tmp_path / "ck.npz")
+    save_state(path, st, CFG)
+    loaded, config = load_state(path)
+    assert config == CFG
+    _trees_equal(st, loaded)
+
+
+def test_resume_matches_straight_run(tmp_path):
+    straight = build_batched_run(CFG, max_cycles=100_000)(_state())
+    straight = jax.tree_util.tree_map(
+        lambda x: x.block_until_ready(), straight
+    )
+    assert bool(jnp.all(jax.vmap(quiescent)(straight)))
+
+    # interrupted: advance in chunks, checkpoint, reload mid-flight,
+    # continue from the loaded state only
+    chunk = build_batched_run_chunk(CFG, 5)
+    st = chunk(_state())
+    path = str(tmp_path / "mid.npz")
+    save_state(path, st, CFG)
+    resumed, _ = load_state(path)
+    while not bool(jnp.all(jax.vmap(quiescent)(resumed))):
+        resumed = chunk(resumed)
+    _trees_equal(straight, resumed)
+
+
+def test_load_rejects_non_checkpoint(tmp_path):
+    p = tmp_path / "junk.npz"
+    np.savez(str(p), meta_magic=np.array("nope"))
+    with pytest.raises(ValueError, match="not a hpa2 checkpoint"):
+        load_state(str(p))
+
+
+def test_latest_checkpoint_picks_highest(tmp_path):
+    st = _state(batch=1, instrs=4)
+    for k in (1, 3, 2):
+        save_state(str(tmp_path / f"ckpt_{k}.npz"), st, CFG)
+    assert latest_checkpoint(str(tmp_path)).endswith("ckpt_3.npz")
+    assert latest_checkpoint(str(tmp_path / "missing")) is None
+
+
+def test_cli_bench_checkpoint_completes_and_cleans(tmp_path):
+    from hpa2_tpu.cli import main
+
+    ckdir = str(tmp_path / "ck")
+    args = [
+        "bench", "--backend", "jax", "--nodes", "4", "--batch", "2",
+        "--instrs", "16", "--robust", "--checkpoint-every", "10",
+        "--checkpoint-dir", ckdir,
+    ]
+    assert main(args) == 0
+    # completion clears the checkpoints (a rerun must not "resume" the
+    # quiescent final state and report a zero-work benchmark)
+    assert latest_checkpoint(ckdir) is None
+    assert main(args) == 0
+
+
+def test_cli_bench_resumes_from_mid_checkpoint(tmp_path, capsys):
+    """Simulated crash: a mid-flight checkpoint in the dir is picked
+    up (matching config+workload meta) and the run completes."""
+    from hpa2_tpu.cli import main
+    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+    cfg = SystemConfig(num_procs=4, semantics=Semantics().robust())
+    seed, batch, instrs = 0, 2, 16
+    st = init_state_batched(
+        cfg, *gen_uniform_random_arrays(cfg, batch, instrs, seed=seed)
+    )
+    st = build_batched_run_chunk(cfg, 10)(st)
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    save_state(
+        str(ckdir / "ckpt_1.npz"), st, cfg,
+        extra_meta={"batch": batch, "instrs": instrs,
+                    "workload": "uniform", "seed": seed},
+    )
+    assert main([
+        "bench", "--backend", "jax", "--nodes", "4", "--batch",
+        str(batch), "--instrs", str(instrs), "--robust",
+        "--checkpoint-every", "10", "--checkpoint-dir", str(ckdir),
+    ]) == 0
+    assert "resumed from" in capsys.readouterr().err
+
+
+def test_cli_bench_rejects_mismatched_checkpoint(tmp_path):
+    from hpa2_tpu.cli import main
+    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+    cfg = SystemConfig(num_procs=4, semantics=Semantics().robust())
+    st = init_state_batched(
+        cfg, *gen_uniform_random_arrays(cfg, 2, 16, seed=0)
+    )
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    save_state(
+        str(ckdir / "ckpt_1.npz"), st, cfg,
+        extra_meta={"batch": 2, "instrs": 16, "workload": "uniform",
+                    "seed": 0},
+    )
+    with pytest.raises(SystemExit, match="different config/workload"):
+        main([
+            "bench", "--backend", "jax", "--nodes", "4", "--batch", "2",
+            "--instrs", "16", "--robust", "--seed", "5",
+            "--checkpoint-every", "10", "--checkpoint-dir", str(ckdir),
+        ])

@@ -54,3 +54,41 @@ def test_run_omp_backend(tmp_path, reference_tests_dir):
         got = (tmp_path / f"core_{i}_output.txt").read_text()
         want = (reference_tests_dir / "test_2" / f"core_{i}_output.txt").read_text()
         assert got == want
+
+
+def test_run_node_sharded_matches_fixtures(tmp_path, reference_tests_dir):
+    """--node-shards on run: the sharded engine is bit-identical to
+    the single-chip one, so fixture parity must hold unchanged."""
+    rc = main([
+        "run", str(reference_tests_dir / "test_1"),
+        "--backend", "jax", "--node-shards", "2", "--out", str(tmp_path),
+    ])
+    assert rc == 0
+    for i in range(4):
+        got = (tmp_path / f"core_{i}_output.txt").read_text()
+        want = (
+            reference_tests_dir / "test_1" / f"core_{i}_output.txt"
+        ).read_text()
+        assert got == want
+
+
+def test_bench_grid_sharded_json(capsys):
+    """--node-shards x --data-shards bench: a sharded ensemble of
+    sharded systems over the virtual CPU mesh."""
+    rc = main([
+        "bench", "--backend", "jax", "--nodes", "8", "--instrs", "8",
+        "--batch", "4", "--node-shards", "2", "--data-shards", "2",
+        "--robust", "--max-instr", "0",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["instrs"] == 8 * 8 * 4
+    assert out["node_shards"] == 2 and out["data_shards"] == 2
+
+
+def test_shard_flags_rejected_for_non_jax():
+    with pytest.raises(SystemExit, match="jax-backend"):
+        main([
+            "bench", "--backend", "omp", "--node-shards", "2",
+            "--instrs", "8",
+        ])
