@@ -245,7 +245,12 @@ def build_cycle(config: SystemConfig, bb: int):
         upd_dir = false
         mem_write = false
         mem_val = mem_blk
-        waiting = s["waiting"] != 0
+        # `waiting` stays i32 (0/1) through the whole cycle: Mosaic
+        # cannot lower selects/broadcasts that materialize i1 vectors
+        # from scalar bool constants (arith.trunci i8->i1, the
+        # BENCH_r03 compile failure), so bool state is never stored or
+        # selected — only compared at use sites.
+        waiting = s["waiting"]
 
         def typ(t):
             return mt == int(t)
@@ -278,7 +283,7 @@ def build_cycle(config: SystemConfig, bb: int):
         nl_addr = jnp.where(mk, a, nl_addr)
         nl_val = jnp.where(mk, v, nl_val)
         nl_state = jnp.where(mk, jnp.where(msh == 2, _E, _S), nl_state)
-        waiting = jnp.where(mk, False, waiting)
+        waiting = jnp.where(mk, 0, waiting)
 
         # --- WRITEBACK_INT (assignment.c:249-271) --------------------
         mk = typ(MsgType.WRITEBACK_INT)
@@ -305,7 +310,7 @@ def build_cycle(config: SystemConfig, bb: int):
         nl_addr = jnp.where(rq, a, nl_addr)
         nl_val = jnp.where(rq, v, nl_val)
         nl_state = jnp.where(rq, _S, nl_state)
-        waiting = jnp.where(rq, False, waiting)
+        waiting = jnp.where(rq, 0, waiting)
 
         # --- UPGRADE (assignment.c:298-328) --------------------------
         mk = typ(MsgType.UPGRADE) & is_home
@@ -325,7 +330,7 @@ def build_cycle(config: SystemConfig, bb: int):
         fan = mk & line_match
         inv_sharers = jnp.where(fan, msh & ~_bit(iota_n), inv_sharers)
         inv_addr = jnp.where(fan, a, inv_addr)
-        waiting = jnp.where(mk, False, waiting)
+        waiting = jnp.where(mk, 0, waiting)
 
         # --- INV (assignment.c:366-373) ------------------------------
         mk = typ(MsgType.INV)
@@ -358,7 +363,7 @@ def build_cycle(config: SystemConfig, bb: int):
         nl_addr = jnp.where(mk, a, nl_addr)
         nl_val = jnp.where(mk, pw, nl_val)
         nl_state = jnp.where(mk, _M, nl_state)
-        waiting = jnp.where(mk, False, waiting)
+        waiting = jnp.where(mk, 0, waiting)
 
         # --- WRITEBACK_INV (assignment.c:451-473) --------------------
         mk = typ(MsgType.WRITEBACK_INV)
@@ -390,7 +395,7 @@ def build_cycle(config: SystemConfig, bb: int):
             rq, v if sem.flush_invack_fills_old_value else pw, nl_val
         )
         nl_state = jnp.where(rq, _M, nl_state)
-        waiting = jnp.where(rq, False, waiting)
+        waiting = jnp.where(rq, 0, waiting)
 
         # --- EVICT_SHARED home role (assignment.c:498-521) -----------
         mk = typ(MsgType.EVICT_SHARED) & is_home & _test_bit(dsh, snd)
@@ -444,7 +449,7 @@ def build_cycle(config: SystemConfig, bb: int):
 
         # ===== phase B: instruction issue ============================
         tr_len = s["tr_len"]
-        elig = (count2 == 0) & ~waiting & ~blocked & (s["pc"] < tr_len)
+        elig = (count2 == 0) & (waiting == 0) & ~blocked & (s["pc"] < tr_len)
         t_dim = s["tr_op"].shape[1]
         pcc = jnp.minimum(s["pc"], t_dim - 1)
         iota_tr = jax.lax.broadcasted_iota(I32, (n, t_dim, bb), 1)
@@ -475,7 +480,7 @@ def build_cycle(config: SystemConfig, bb: int):
         put(sB1, wh_s, recv=home2, type_=int(MsgType.UPGRADE), addr=ia)
 
         pending_write = jnp.where(is_wr, iv, s["pending_write"])
-        waiting = waiting | rm | wm | wh_s
+        waiting = jnp.where(rm | wm | wh_s, 1, waiting)
 
         i_upd = rm | wm | wh_me | wh_s
         n2_addr = jnp.where(rm | wm, ia, l2_addr)
@@ -629,7 +634,7 @@ def build_cycle(config: SystemConfig, bb: int):
 
         # ===== phase D: dump-at-local-completion snapshots ===========
         done_node = (
-            (pc >= tr_len) & ~waiting & (mb_count3 == 0) & ~blocked_next
+            (pc >= tr_len) & (waiting == 0) & (mb_count3 == 0) & ~blocked_next
         )
         snap_now = done_node & ~(s["snap_taken"] != 0)
         s2 = snap_now[:, None, :]
@@ -669,7 +674,7 @@ def build_cycle(config: SystemConfig, bb: int):
             "cache_state": cache_state, "mem": mem,
             "dir_state": dir_state, "dir_sharers": dir_sharers,
             "mb": mb, "mb_count": mb_count3, "pc": pc,
-            "waiting": waiting.astype(I32),
+            "waiting": waiting,
             "pending_write": pending_write,
             "ob": ob_new, "ob_valid": ob_valid_new,
             "snap_taken": ((s["snap_taken"] != 0) | done_node).astype(I32),
@@ -872,6 +877,7 @@ class PallasEngine:
         b = tr_op.shape[0]
         self.config = config
         self.b = b
+        self._interpret_active = interpret
         # largest divisor of the batch not exceeding the requested
         # block (the grid tiles the ensemble axis exactly)
         block = min(block, b)

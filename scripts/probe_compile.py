@@ -1,0 +1,41 @@
+"""Compile-only probe of the Pallas kernel on the TPU (no execution of
+the full bench).  Exit 0 + one JSON line on success; nonzero + the
+Mosaic error tail on failure.  Run under the TPU env."""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from hpa2_tpu.config import Semantics, SystemConfig
+    from hpa2_tpu.ops import pallas_engine as pe
+
+    config = SystemConfig(
+        num_procs=8, msg_buffer_size=32, semantics=Semantics().robust()
+    )
+    b, bb, k = 128, 128, 8
+    tr_op = np.zeros((b, 8, 16), np.int32)
+    tr_addr = np.zeros((b, 8, 16), np.int32)
+    tr_val = np.zeros((b, 8, 16), np.int32)
+    tr_len = np.full((b, 8), 16, np.int32)
+    state, traces = pe._init_transposed(config, tr_op, tr_addr, tr_val, tr_len)
+    state = {f: jax.numpy.asarray(v) for f, v in state.items()}
+    traces = {f: jax.numpy.asarray(v) for f, v in traces.items()}
+    call = pe._build_call(config, b, bb, k, False)
+    t0 = time.time()
+    lowered = call.lower(state, traces)
+    compiled = lowered.compile()
+    dt = time.time() - t0
+    print(json.dumps({"ok": True, "compile_s": round(dt, 1),
+                      "platform": jax.devices()[0].platform}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
