@@ -747,6 +747,20 @@ class SpecEngine:
             else:
                 stall = 0
 
+    def continue_with(self, traces: Sequence[Sequence[Instr]]) -> None:
+        """Swap in the next per-node instruction window after
+        quiescence and restart the program counters — the spec-side
+        mirror of PallasEngine's ``trace_window`` schedule (a legal
+        re-scheduling of one long program as successive quiesced
+        windows)."""
+        if not self.quiescent():
+            raise StallError("continue_with requires a quiescent system")
+        if len(traces) != self.config.num_procs:
+            raise ValueError("need one trace per node")
+        for nd, tr in zip(self.nodes, traces):
+            nd.trace = list(tr)
+            nd.pc = 0
+
     # -- results ------------------------------------------------------
 
     def snapshots(self) -> List[NodeDump]:

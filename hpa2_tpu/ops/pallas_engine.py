@@ -11,20 +11,58 @@ TPU vector lanes (blocks of ``BB`` systems per grid step), and the
 per-system structure (nodes, cache/memory/queue slots) lives in
 sublanes:
 
-    cache_*   [N, C, B]      mem/dir_* [N, M, B]
-    mb        [N, F, cap, B] (packed message fields, head at slot 0)
-    tr_*      [N, T, B]      scalars/counters [SC, B] rows
+    cachew  [N, C, B]     state | value<<2 | (addr+1)<<10
+    dirw    [N, M, B]     mem | dir_state<<8 | sharers<<10
+    mb{w}   [N, cap, B]   packed message words, head at slot 0
+    tr      [N, T, B]     packed instruction words
+    scalars/counters      [SC, B] rows
+
+The round-4 perf redesign, driven by scripts/micro_kernels.py on a
+v5e chip (per-op dispatch overhead ~15-30ns dominates; data size is
+nearly free at small blocks):
+
+* messages pack into W config-derived words (W=1 for the reference
+  geometry) so the deterministic-delivery loop issues one masked
+  write per candidate instead of six;
+* the directory row (memory byte, dir state, sharer mask) and the
+  cache line (state, value byte, tag) each pack into one word, so a
+  handler touches one one-hot read + one one-hot write per structure
+  instead of three;
+* the per-cycle quiescence early-exit (a scalar reduce + branch,
+  ~8.5us/cycle measured) runs every ``_GATE`` cycles instead of every
+  cycle;
+* blocks default to 1024 lanes so each op amortizes its fixed cost
+  over 8x more systems, with a sliding ``trace_window`` keeping the
+  trace plane — the VMEM whale — small for long workloads.
+
+Message fields are type(4) | sender | second+1 | addr | aux, packed to
+31 bits per word.  ``aux`` is a union the protocol never uses twice
+at once: byte value | excl<<8 for REPLY_RD, the sharer mask for
+REPLY_ID, the rd/wr flag for NACK, the byte value for FLUSH*/EVICT*/
+WRITE_REQUEST.  Values are bytes by construction (trace parse is
+``%hhu`` mod 256, assignment.c:804-818, and memory is byte-typed,
+assignment.c:48).  Instructions pack as op(1) | value(8) | addr into
+one word.
 
 Semantics are *identical* to ops/step.py (fixture semantics + optional
 NACK robustness, SURVEY.md §6.2/§6.3): the cycle body below is a
 re-lowering of the same spec — phase A handle-one-message, phase B
 issue, phase C deterministic delivery in (phase, sender, slot) order,
 phase D dump-at-local-completion snapshots.  Differential tests gate
-it against the spec engine and the XLA engine.
+it against the spec engine and the XLA engine; scripts/
+tpu_differential.py gates the Mosaic path on hardware.  A
+``trace_window`` run inserts quiescence barriers between windows —
+a legal schedule of the same program, differential-tested against the
+spec engine run on the same segment schedule.
 
-Restrictions: ``num_procs <= 32`` (single sharer word), no replay mode
-(fixture replays run on the XLA/spec engines), ``5 * num_procs`` send
-candidates must fit the mailbox capacity check as usual.
+Mosaic constraints honored throughout: no bool tensor is ever stored,
+selected against a scalar bool constant, or reduced (`arith.trunci
+i8->i1`, the BENCH_r03 compile failure) — masks live as i32 0/1 and
+comparisons happen at use sites; reductions are integer sums.
+
+Restrictions: ``num_procs <= 21`` (sharer mask must share the packed
+directory word; the XLA engine covers wider geometries), addresses
+< 2^21, no replay mode (fixture replays run on the XLA/spec engines).
 """
 
 from __future__ import annotations
@@ -56,17 +94,8 @@ _DU = int(DirState.U)
 _NO_MSG = -1
 _INVALID_ADDR = -1
 
-# packed mailbox field rows (mb[:, row, slot, :])
-_F_TYPE, _F_SENDER, _F_ADDR, _F_VALUE, _F_SECOND, _F_SHARERS = range(6)
-_NFIELD = 6
-
-# deferred-send outbox rows (ob[:, row, slot, :]): the mailbox rows
-# plus the receiver; slots are the candidate grid [A0, A1, AINV, B0,
-# B1].  Slot 2 (AINV) keeps the *remaining* INV delivery mask in its
-# SHARERS row.  A node with any valid slot is blocked (capacity
-# backpressure; mirrors ops/step.py and the spec engine).
-_OB_RECV = _NFIELD
-_OB_NROWS = _NFIELD + 1
+# candidate-grid slots, in delivery order: phase A point sends, the
+# INV fanout, then phase B point sends
 _NSLOTS = 5
 
 # scalar counter rows (scalars[row, :])
@@ -76,17 +105,75 @@ _NSCALAR = 10
 
 _NTYPES = len(MsgType)
 
-#: carried state field names, in kernel argument order
-STATE_FIELDS = (
-    "cache_addr", "cache_val", "cache_state",
-    "mem", "dir_state", "dir_sharers",
-    "mb", "mb_count", "pc", "waiting", "pending_write",
-    "ob", "ob_valid",
-    "snap_taken", "snap_mem", "snap_dir_state", "snap_dir_sharers",
-    "snap_cache_addr", "snap_cache_val", "snap_cache_state",
-    "scalars", "msg_counts",
-)
-TRACE_FIELDS = ("tr_op", "tr_addr", "tr_val", "tr_len")
+# trace word: op(1) | value(8) | addr(rest)
+_TR_ADDR_SHIFT = 9
+
+# packed directory word: mem(8) | dir_state(2) | sharers(<=21)
+_DW_STATE_SHIFT = 8
+_DW_SH_SHIFT = 10
+# packed cache word: state(2) | value(8) | addr+1(<=21)
+_CW_VAL_SHIFT = 2
+_CW_ADDR_SHIFT = 10
+
+# quiescence early-exit granularity (cycles); the gate is a scalar
+# reduce + branch measured at ~8.5us — amortize it
+_GATE = 8
+
+
+def _bits_for(n_values: int) -> int:
+    """Bits to store 0 .. n_values-1."""
+    b = 1
+    while (1 << b) < n_values:
+        b += 1
+    return b
+
+
+@functools.lru_cache(maxsize=64)
+def _mb_layout(config: SystemConfig):
+    """Field -> (word, offset, width) packing for one message, plus the
+    word count W.  Words hold at most 31 bits (sign-safe shifts)."""
+    n = config.num_procs
+    fields = (
+        ("type", 4),
+        ("sender", _bits_for(n)),
+        ("second", _bits_for(n + 1)),   # stored as second+1
+        ("addr", _bits_for(config.num_addresses)),
+        ("aux", max(n, 9)),             # byte value | excl<<8, or mask
+    )
+    layout = {}
+    word, off = 0, 0
+    for name, wd in fields:
+        if off + wd > 31:
+            word, off = word + 1, 0
+        layout[name] = (word, off, wd)
+        off += wd
+    return layout, word + 1
+
+
+def _check_geometry(config: SystemConfig) -> None:
+    if config.num_procs > 21:
+        raise ValueError(
+            "pallas engine supports num_procs <= 21 (packed directory "
+            "word); use the XLA engine for wider systems"
+        )
+    if config.num_addresses >= (1 << 21):
+        raise ValueError("pallas engine supports addresses < 2^21")
+
+
+#: per-engine carried state names, in kernel argument order
+def _state_fields(W: int, snapshots: bool):
+    f = ["cachew", "dirw"]
+    f += [f"mb{w}" for w in range(W)]
+    f += ["mb_count", "pc", "waiting", "pending_write"]
+    f += [f"ob{w}" for w in range(W)]
+    f += ["ob_recv", "ob_valid"]
+    if snapshots:
+        f += ["snap_taken", "snap_cachew", "snap_dirw"]
+    f += ["scalars", "msg_counts"]
+    return tuple(f)
+
+
+TRACE_FIELDS = ("tr", "tr_len")
 
 
 def _popcount(x):
@@ -117,18 +204,52 @@ def _test_bit(mask, proc):
     return (mask >> jnp.clip(proc, 0, 31)) & 1 == 1
 
 
-def build_cycle(config: SystemConfig, bb: int):
+def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
+                ablate: frozenset = frozenset()):
     """One lockstep cycle over a block of ``bb`` systems in transposed
     layout.  Pure jnp on a state dict — runs inside the Pallas kernel
-    and, for validation, directly under jit/CPU."""
+    and, for validation, directly under jit/CPU.
+
+    ``ablate`` (perf tooling only, scripts/perf_sweep.py --ablate):
+    named cycle stages are stubbed out to attribute per-cycle time on
+    real hardware.  An ablated cycle is semantically WRONG — never use
+    outside timing runs."""
     n, c, m = config.num_procs, config.cache_size, config.mem_size
     cap = config.msg_buffer_size
     sem = config.semantics
-    if n > 32:
-        raise ValueError("pallas engine supports num_procs <= 32")
+    _check_geometry(config)
     if sem.overloaded_evict_shared_notify:
         raise ValueError("pallas engine implements fixture semantics only")
     nack = sem.intervention_miss_policy == "nack"
+    layout, W = _mb_layout(config)
+    sh_mask = (1 << n) - 1
+    addr_mask = (1 << 21) - 1
+
+    def dec(words, name):
+        w, off, wd = layout[name]
+        x = words[w]
+        if off:
+            x = x >> off
+        if wd < 32:
+            x = x & ((1 << wd) - 1)
+        return x
+
+    def enc(type_, sender, second, addr, aux):
+        """Pack logical field rows into W word rows (any shape)."""
+        vals = {"type": type_, "sender": sender, "second": second + 1,
+                "addr": addr, "aux": aux}
+        out = []
+        for w in range(W):
+            acc = None
+            for name, (ww, off, wd) in layout.items():
+                if ww != w:
+                    continue
+                x = vals[name]
+                if off:
+                    x = x << off
+                acc = x if acc is None else acc | x
+            out.append(acc)
+        return out
 
     def cycle(s: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
         s = dict(s)
@@ -162,18 +283,23 @@ def build_cycle(config: SystemConfig, bb: int):
 
         # ===== phase A: handle one message per node ==================
         has_msg = (s["mb_count"] > 0) & ~blocked
-        head = s["mb"][:, :, 0, :]                       # [N, F, B]
-        mt = jnp.where(has_msg, head[:, _F_TYPE, :], _NO_MSG)
-        snd = head[:, _F_SENDER, :]
-        a = jnp.maximum(head[:, _F_ADDR, :], 0)
-        v = head[:, _F_VALUE, :]
-        sr = head[:, _F_SECOND, :]
-        msh = head[:, _F_SHARERS, :]
+        heads = [s[f"mb{w}"][:, 0, :] for w in range(W)]    # [N, B]
+        mt = jnp.where(has_msg, dec(heads, "type"), _NO_MSG)
+        if "phase_a" in ablate:  # handlers fold to no-ops
+            mt = jnp.full((n, bb), _NO_MSG, I32)
+        snd = dec(heads, "sender")
+        sr = dec(heads, "second") - 1
+        a = dec(heads, "addr")
+        aux = dec(heads, "aux")
+        v = aux & 0xFF
 
-        rolled = jnp.concatenate(
-            [s["mb"][:, :, 1:, :], s["mb"][:, :, :1, :]], axis=2
-        )
-        qdata = jnp.where(has_msg[:, None, None, :], rolled, s["mb"])
+        qdata = []
+        for w in range(W):
+            rolled = jnp.concatenate(
+                [s[f"mb{w}"][:, 1:, :], s[f"mb{w}"][:, :1, :]], axis=1
+            )
+            qdata.append(jnp.where(has_msg[:, None, :], rolled,
+                                   s[f"mb{w}"]))
         count2 = s["mb_count"] - has_msg.astype(I32)
 
         home = a // m
@@ -182,12 +308,14 @@ def build_cycle(config: SystemConfig, bb: int):
         is_home = iota_n == home
         is_second = iota_n == sr
 
-        line_addr = read_c(s["cache_addr"], ci)
-        line_val = read_c(s["cache_val"], ci)
-        line_state = read_c(s["cache_state"], ci)
-        ds = read_m(s["dir_state"], blk)
-        dsh = read_m(s["dir_sharers"], blk)
-        mem_blk = read_m(s["mem"], blk)
+        cw = read_c(s["cachew"], ci)
+        line_state = cw & 3
+        line_val = (cw >> _CW_VAL_SHIFT) & 0xFF
+        line_addr = ((cw >> _CW_ADDR_SHIFT) & addr_mask) - 1
+        dw = read_m(s["dirw"], blk)
+        mem_blk = dw & 0xFF
+        ds = (dw >> _DW_STATE_SHIFT) & 3
+        dsh = (dw >> _DW_SH_SHIFT) & sh_mask
         pw = s["pending_write"]
 
         line_match = line_addr == a
@@ -202,36 +330,33 @@ def build_cycle(config: SystemConfig, bb: int):
         def slot():
             return {
                 "valid": false, "recv": zero, "type": zero, "addr": zero,
-                "value": zero, "second": jnp.full((n, bb), -1, I32),
-                "sharers": zero,
+                "aux": zero, "second": jnp.full((n, bb), -1, I32),
             }
 
-        def put(sl, mask, recv, type_, addr, value=None, sharers=None,
-                second=None):
+        def put(sl, mask, recv, type_, addr, aux=None, second=None):
             sl["valid"] = sl["valid"] | mask
             sl["recv"] = jnp.where(mask, recv, sl["recv"])
             sl["type"] = jnp.where(mask, type_, sl["type"])
             sl["addr"] = jnp.where(mask, addr, sl["addr"])
-            if value is not None:
-                sl["value"] = jnp.where(mask, value, sl["value"])
-            if sharers is not None:
-                sl["sharers"] = jnp.where(mask, sharers, sl["sharers"])
+            if aux is not None:
+                sl["aux"] = jnp.where(mask, aux, sl["aux"])
             if second is not None:
                 sl["second"] = jnp.where(mask, second, sl["second"])
 
         def evict_msg(sl, mask, l_addr, l_val, l_state):
             """handleCacheReplacement (assignment.c:742-773)."""
             vv = mask & (l_addr != _INVALID_ADDR) & (l_state != _I)
+            sane = jnp.maximum(l_addr, 0)
             put(
                 sl, vv,
-                recv=jnp.maximum(l_addr, 0) // m,
+                recv=sane // m,
                 type_=jnp.where(
                     l_state == _M,
                     int(MsgType.EVICT_MODIFIED),
                     int(MsgType.EVICT_SHARED),
                 ),
-                addr=l_addr,
-                value=l_val,
+                addr=sane,
+                aux=l_val,
             )
             return vv
 
@@ -261,8 +386,7 @@ def build_cycle(config: SystemConfig, bb: int):
         reply_mask = mk & (du | dss | (dem & owner_is_snd))
         excl = du | (dem & owner_is_snd)
         put(sA0, reply_mask, recv=snd, type_=int(MsgType.REPLY_RD),
-            addr=a, value=mem_blk,
-            sharers=jnp.where(excl, I32(2), I32(0)))
+            addr=a, aux=mem_blk | jnp.where(excl, I32(256), I32(0)))
         fwd = mk & dem & ~owner_is_snd
         put(sA0, fwd, recv=owner, type_=int(MsgType.WRITEBACK_INT),
             addr=a, second=snd)
@@ -282,16 +406,18 @@ def build_cycle(config: SystemConfig, bb: int):
         upd_line = upd_line | mk
         nl_addr = jnp.where(mk, a, nl_addr)
         nl_val = jnp.where(mk, v, nl_val)
-        nl_state = jnp.where(mk, jnp.where(msh == 2, _E, _S), nl_state)
+        nl_state = jnp.where(
+            mk, jnp.where((aux >> 8) & 1 == 1, _E, _S), nl_state
+        )
         waiting = jnp.where(mk, 0, waiting)
 
         # --- WRITEBACK_INT (assignment.c:249-271) --------------------
         mk = typ(MsgType.WRITEBACK_INT)
         ok = mk & line_match & line_me
         put(sA0, ok, recv=home, type_=int(MsgType.FLUSH), addr=a,
-            value=line_val, second=sr)
+            aux=line_val, second=sr)
         put(sA1, ok & (sr != home), recv=sr, type_=int(MsgType.FLUSH),
-            addr=a, value=line_val, second=sr)
+            addr=a, aux=line_val, second=sr)
         upd_line = upd_line | ok
         nl_state = jnp.where(ok, _S, nl_state)
         if nack:
@@ -316,7 +442,7 @@ def build_cycle(config: SystemConfig, bb: int):
         mk = typ(MsgType.UPGRADE) & is_home
         reply_sh = jnp.where(mk & (ds == _DS), dsh & ~snd_bit, 0)
         put(sA0, mk, recv=snd, type_=int(MsgType.REPLY_ID), addr=a,
-            sharers=reply_sh)
+            aux=reply_sh)
         upd_dir = upd_dir | mk
         nd_state = jnp.where(mk, _EM, nd_state)
         nd_sharers = jnp.where(mk, snd_bit, nd_sharers)
@@ -328,7 +454,7 @@ def build_cycle(config: SystemConfig, bb: int):
         nl_val = jnp.where(fill, pw, nl_val)
         nl_state = jnp.where(fill, _M, nl_state)
         fan = mk & line_match
-        inv_sharers = jnp.where(fan, msh & ~_bit(iota_n), inv_sharers)
+        inv_sharers = jnp.where(fan, aux & ~_bit(iota_n), inv_sharers)
         inv_addr = jnp.where(fan, a, inv_addr)
         waiting = jnp.where(mk, 0, waiting)
 
@@ -349,7 +475,7 @@ def build_cycle(config: SystemConfig, bb: int):
         put(sA0, mk & (du | (dem & owner_is_snd)), recv=snd,
             type_=int(MsgType.REPLY_WR), addr=a)
         put(sA0, mk & dss, recv=snd, type_=int(MsgType.REPLY_ID),
-            addr=a, sharers=dsh & ~snd_bit)
+            addr=a, aux=dsh & ~snd_bit)
         wr_fwd = mk & dem & ~owner_is_snd
         put(sA0, wr_fwd, recv=owner, type_=int(MsgType.WRITEBACK_INV),
             addr=a, second=snd)
@@ -369,16 +495,16 @@ def build_cycle(config: SystemConfig, bb: int):
         mk = typ(MsgType.WRITEBACK_INV)
         ok = mk & line_match & line_me
         put(sA0, ok, recv=home, type_=int(MsgType.FLUSH_INVACK),
-            addr=a, value=line_val, second=sr)
+            addr=a, aux=line_val, second=sr)
         put(sA1, ok & (sr != home), recv=sr,
-            type_=int(MsgType.FLUSH_INVACK), addr=a, value=line_val,
+            type_=int(MsgType.FLUSH_INVACK), addr=a, aux=line_val,
             second=sr)
         upd_line = upd_line | ok
         nl_state = jnp.where(ok, _I, nl_state)
         if nack:
             put(sA0, mk & ~(line_match & line_me), recv=home,
-                type_=int(MsgType.NACK), addr=a, sharers=jnp.full_like(zero, 1),
-                second=sr)
+                type_=int(MsgType.NACK), addr=a,
+                aux=jnp.full_like(zero, 1), second=sr)
 
         # --- FLUSH_INVACK (assignment.c:475-496) ---------------------
         mk = typ(MsgType.FLUSH_INVACK)
@@ -427,8 +553,8 @@ def build_cycle(config: SystemConfig, bb: int):
         # --- NACK re-serve (robust mode; spec_engine) ----------------
         if nack:
             mk = typ(MsgType.NACK) & is_home
-            rd = mk & (msh == 0)
-            wr = mk & (msh != 0)
+            rd = mk & (aux == 0)
+            wr = mk & (aux != 0)
             sr_bit = _bit(sr)
             upd_dir = upd_dir | mk
             nd_state = jnp.where(rd, _DS, nd_state)
@@ -436,34 +562,47 @@ def build_cycle(config: SystemConfig, bb: int):
             nd_sharers = jnp.where(rd, nd_sharers | sr_bit, nd_sharers)
             nd_sharers = jnp.where(wr, sr_bit, nd_sharers)
             put(sA0, rd, recv=sr, type_=int(MsgType.REPLY_RD), addr=a,
-                value=mem_blk)
+                aux=mem_blk)
             put(sA0, wr, recv=sr, type_=int(MsgType.REPLY_WR), addr=a)
 
-        # apply phase-A updates
-        cache_addr = write_c(s["cache_addr"], ci, upd_line, nl_addr)
-        cache_val = write_c(s["cache_val"], ci, upd_line, nl_val)
-        cache_state = write_c(s["cache_state"], ci, upd_line, nl_state)
-        dir_state = write_m(s["dir_state"], blk, upd_dir, nd_state)
-        dir_sharers = write_m(s["dir_sharers"], blk, upd_dir, nd_sharers)
-        mem = write_m(s["mem"], blk, mem_write, mem_val)
+        # apply phase-A updates: the three cache/directory structures
+        # share their packed word, so each applies in ONE one-hot write
+        cw_val = (
+            nl_state | (nl_val << _CW_VAL_SHIFT)
+            | ((nl_addr + 1) << _CW_ADDR_SHIFT)
+        )
+        cachew = write_c(s["cachew"], ci, upd_line, cw_val)
+        new_mem = jnp.where(mem_write, mem_val, mem_blk)
+        new_ds = jnp.where(upd_dir, nd_state, ds)
+        new_dsh = jnp.where(upd_dir, nd_sharers, dsh)
+        dw_val = (
+            new_mem | (new_ds << _DW_STATE_SHIFT)
+            | (new_dsh << _DW_SH_SHIFT)
+        )
+        dirw = write_m(s["dirw"], blk, mem_write | upd_dir, dw_val)
 
         # ===== phase B: instruction issue ============================
         tr_len = s["tr_len"]
-        elig = (count2 == 0) & (waiting == 0) & ~blocked & (s["pc"] < tr_len)
-        t_dim = s["tr_op"].shape[1]
+        elig = (
+            (count2 == 0) & (waiting == 0) & ~blocked & (s["pc"] < tr_len)
+        )
+        if "phase_b" in ablate:
+            elig = false
+        t_dim = s["tr"].shape[1]
         pcc = jnp.minimum(s["pc"], t_dim - 1)
         iota_tr = jax.lax.broadcasted_iota(I32, (n, t_dim, bb), 1)
         hot_tr = iota_tr == pcc[:, None, :]
-        fetch = lambda arr: jnp.sum(jnp.where(hot_tr, arr, 0), axis=1)
-        op = fetch(s["tr_op"])
-        ia = fetch(s["tr_addr"])
-        iv = fetch(s["tr_val"])
+        wi = jnp.sum(jnp.where(hot_tr, s["tr"], 0), axis=1)
+        op = wi & 1
+        iv = (wi >> 1) & 0xFF
+        ia = wi >> _TR_ADDR_SHIFT
         ci2 = ia % c
         home2 = ia // m
 
-        l2_addr = read_c(cache_addr, ci2)
-        l2_val = read_c(cache_val, ci2)
-        l2_state = read_c(cache_state, ci2)
+        cw2 = read_c(cachew, ci2)
+        l2_state = cw2 & 3
+        l2_val = (cw2 >> _CW_VAL_SHIFT) & 0xFF
+        l2_addr = ((cw2 >> _CW_ADDR_SHIFT) & addr_mask) - 1
         hit = (l2_addr == ia) & (l2_state != _I)
         is_rd = elig & (op == 0)
         is_wr = elig & (op == 1)
@@ -474,7 +613,7 @@ def build_cycle(config: SystemConfig, bb: int):
         ev_issue = evict_msg(sB0, rm | wm, l2_addr, l2_val, l2_state)
         put(sB1, rm, recv=home2, type_=int(MsgType.READ_REQUEST), addr=ia)
         put(sB1, wm, recv=home2, type_=int(MsgType.WRITE_REQUEST),
-            addr=ia, value=iv)
+            addr=ia, aux=iv)
         wh_me = is_wr & hit & ((l2_state == _M) | (l2_state == _E))
         wh_s = is_wr & hit & (l2_state == _S)
         put(sB1, wh_s, recv=home2, type_=int(MsgType.UPGRADE), addr=ia)
@@ -488,30 +627,35 @@ def build_cycle(config: SystemConfig, bb: int):
         n2_state = jnp.where(
             rm | wm, _I, jnp.where(wh_me | wh_s, _M, l2_state)
         )
-        cache_addr = write_c(cache_addr, ci2, i_upd, n2_addr)
-        cache_val = write_c(cache_val, ci2, i_upd, n2_val)
-        cache_state = write_c(cache_state, ci2, i_upd, n2_state)
+        cw2_val = (
+            n2_state | (n2_val << _CW_VAL_SHIFT)
+            | ((n2_addr + 1) << _CW_ADDR_SHIFT)
+        )
+        cachew = write_c(cachew, ci2, i_upd, cw2_val)
         pc = s["pc"] + elig.astype(I32)
 
         # merge deferred sends back into their candidate-grid slots
         # (blocked nodes made no new sends, so the where-merge is exact)
-        ob, obv = s["ob"], s["ob_valid"]
+        obv = s["ob_valid"]
 
         def merge_slot(sl, k):
             pv = obv[:, k, :] != 0
+            words = [s[f"ob{w}"][:, k, :] for w in range(W)]
             sl["valid"] = sl["valid"] | pv
-            for name, row in (
-                ("recv", _OB_RECV), ("type", _F_TYPE), ("addr", _F_ADDR),
-                ("value", _F_VALUE), ("second", _F_SECOND),
-                ("sharers", _F_SHARERS),
-            ):
-                sl[name] = jnp.where(pv, ob[:, row, k, :], sl[name])
+            sl["recv"] = jnp.where(pv, s["ob_recv"][:, k, :], sl["recv"])
+            sl["type"] = jnp.where(pv, dec(words, "type"), sl["type"])
+            sl["addr"] = jnp.where(pv, dec(words, "addr"), sl["addr"])
+            sl["aux"] = jnp.where(pv, dec(words, "aux"), sl["aux"])
+            sl["second"] = jnp.where(
+                pv, dec(words, "second") - 1, sl["second"]
+            )
 
         merge_slot(sA0, 0)
         merge_slot(sA1, 1)
         pend_inv = obv[:, 2, :] != 0
-        inv_sharers = jnp.where(pend_inv, ob[:, _F_SHARERS, 2, :], inv_sharers)
-        inv_addr = jnp.where(pend_inv, ob[:, _F_ADDR, 2, :], inv_addr)
+        ob2 = [s[f"ob{w}"][:, 2, :] for w in range(W)]
+        inv_sharers = jnp.where(pend_inv, dec(ob2, "aux"), inv_sharers)
+        inv_addr = jnp.where(pend_inv, dec(ob2, "addr"), inv_addr)
         merge_slot(sB0, 3)
         merge_slot(sB1, 4)
 
@@ -522,128 +666,153 @@ def build_cycle(config: SystemConfig, bb: int):
         # fixed traversal).  Each candidate is accepted only while the
         # receiver's queue has space; rejected candidates defer to the
         # sender's outbox (capacity backpressure, as in ops/step.py).
-        mb = qdata
+        # NOTE a fully vectorized [J, N, B] formulation (cumsum over
+        # the candidate axis) measured 2.4x SLOWER on v5e than this
+        # per-candidate loop of small ops — fat 3D temporaries cost
+        # more than the saved op dispatch.
+        mbs = qdata
         acc = zero  # running enqueue offset per receiver
-        msgs_delivered = jnp.zeros((1, bb), dtype=I32)
-        mc_inc = jnp.zeros((_NTYPES, bb), dtype=I32)
+        md = jnp.zeros((1, bb), dtype=I32)
+        mc = jnp.zeros((_NTYPES, bb), dtype=I32)
         # rejected-candidate collectors: [slot][sender] -> [B] rows
         rej_valid = [[None] * n for _ in range(_NSLOTS)]
-        rej_rows = [
-            [[None] * n for _ in range(_NSLOTS)] for _ in range(_OB_NROWS)
+        rej_recv = [[None] * n for _ in range(_NSLOTS)]
+        rej_words = [
+            [[None] * n for _ in range(_NSLOTS)] for _ in range(W)
         ]
 
-        def deliver(mb, acc, md, mc, valid_nb, type_v, fields):
-            """Enqueue one candidate: fields are [B] rows in mb-row
-            order (type, sender, addr, value, second, sharers).
+        def deliver(mbs, acc, md, mc, valid_nb, type_v, words):
+            """Enqueue one candidate (packed words are [B] rows).
             Returns the accepted [N, B] mask as well."""
             pos = count2 + acc
             accepted = valid_nb & (pos < cap)
             hot = (iota_cap == pos[:, None, :]) & accepted[:, None, :]
-            planes = []
-            for frow in range(_NFIELD):
-                planes.append(
-                    jnp.where(hot, fields[frow][None, None, :],
-                              mb[:, frow, :, :])
-                )
-            mb = jnp.stack(planes, axis=1)
+            mbs = [
+                jnp.where(hot, words[w][None, None, :], mbs[w])
+                for w in range(W)
+            ]
             dcount = jnp.sum(accepted.astype(I32), axis=0, keepdims=True)
             md = md + dcount
             mc = mc + jnp.where(iota_t == type_v[None, :], dcount, 0)
-            return mb, acc + accepted.astype(I32), md, mc, accepted
+            return mbs, acc + accepted.astype(I32), md, mc, accepted
 
-        def record_reject(k, sender, valid_b, recv_b, fields):
-            rej_valid[k][sender] = valid_b.astype(I32)
-            for frow in range(_NFIELD):
-                rej_rows[frow][k][sender] = fields[frow]
-            rej_rows[_OB_RECV][k][sender] = recv_b
-
-        def point_candidate(mb, acc, md, mc, sl, k, sender):
+        def point_candidate(mbs, acc, md, mc, sl, k, sender):
             valid_s = sl["valid"][sender]                  # [B]
             recv_s = sl["recv"][sender]
             valid_nb = valid_s[None, :] & (iota_n == recv_s[None, :])
             type_v = sl["type"][sender]
-            fields = [
-                type_v,
-                jnp.full((bb,), sender, I32),
-                sl["addr"][sender],
-                sl["value"][sender],
-                sl["second"][sender],
-                sl["sharers"][sender],
-            ]
-            mb, acc, md, mc, accepted = deliver(
-                mb, acc, md, mc, valid_nb, type_v, fields
+            words = enc(type_v, jnp.full((bb,), sender, I32),
+                        sl["second"][sender], sl["addr"][sender],
+                        sl["aux"][sender])
+            mbs, acc, md, mc, accepted = deliver(
+                mbs, acc, md, mc, valid_nb, type_v, words
             )
-            rejected = valid_s & ~jnp.any(accepted, axis=0)
-            record_reject(k, sender, rejected, recv_s, fields)
-            return mb, acc, md, mc
+            rejected = valid_s & (
+                jnp.sum(accepted.astype(I32), axis=0) == 0
+            )
+            rej_valid[k][sender] = rejected.astype(I32)
+            rej_recv[k][sender] = recv_s
+            for w in range(W):
+                rej_words[w][k][sender] = words[w]
+            return mbs, acc, md, mc
 
-        def inv_candidate(mb, acc, md, mc, sender):
+        aux_w, aux_off, _ = layout["aux"]
+
+        def inv_candidate(mbs, acc, md, mc, sender):
             mask_s = inv_sharers[sender]                   # [B]
             valid_nb = ((mask_s[None, :] >> iota_n) & 1) == 1
             type_v = jnp.full((bb,), int(MsgType.INV), I32)
             addr_s = inv_addr[sender]
-            fields = [
-                type_v,
-                jnp.full((bb,), sender, I32),
-                addr_s,
-                jnp.zeros((bb,), I32),
-                jnp.full((bb,), -1, I32),
-                jnp.zeros((bb,), I32),
-            ]
-            mb, acc, md, mc, accepted = deliver(
-                mb, acc, md, mc, valid_nb, type_v, fields
+            zb = jnp.zeros((bb,), I32)
+            words = enc(type_v, jnp.full((bb,), sender, I32),
+                        jnp.full((bb,), -1, I32), addr_s, zb)
+            mbs, acc, md, mc, accepted = deliver(
+                mbs, acc, md, mc, valid_nb, type_v, words
             )
             remaining = mask_s & ~jnp.sum(
                 accepted.astype(I32) << iota_n, axis=0
             )
             rej_valid[2][sender] = (remaining != 0).astype(I32)
-            for frow in range(_NFIELD):
-                rej_rows[frow][2][sender] = fields[frow]
-            rej_rows[_F_SHARERS][2][sender] = remaining
-            rej_rows[_F_ADDR][2][sender] = addr_s
-            rej_rows[_OB_RECV][2][sender] = jnp.full((bb,), -1, I32)
-            return mb, acc, md, mc
+            rej_recv[2][sender] = jnp.full((bb,), -1, I32)
+            # the *remaining* INV mask rides the (otherwise zero) aux
+            # field of the deferred word
+            for w in range(W):
+                rej_words[w][2][sender] = (
+                    words[w] | (remaining << aux_off)
+                    if w == aux_w else words[w]
+                )
+            return mbs, acc, md, mc
 
-        md = msgs_delivered
-        mc = mc_inc
-        for sender in range(n):
-            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sA0, 0, sender)
-            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sA1, 1, sender)
-            mb, acc, md, mc = inv_candidate(mb, acc, md, mc, sender)
-        for sender in range(n):
-            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sB0, 3, sender)
-            mb, acc, md, mc = point_candidate(mb, acc, md, mc, sB1, 4, sender)
+        if "deliver" in ablate:
+            zrow = jnp.zeros((bb,), I32)
+            for k_ in range(_NSLOTS):
+                for sender in range(n):
+                    rej_valid[k_][sender] = zrow
+                    rej_recv[k_][sender] = zrow
+                    for w in range(W):
+                        rej_words[w][k_][sender] = zrow
+        else:
+            for sender in range(n):
+                mbs, acc, md, mc = point_candidate(mbs, acc, md, mc,
+                                                   sA0, 0, sender)
+                mbs, acc, md, mc = point_candidate(mbs, acc, md, mc,
+                                                   sA1, 1, sender)
+                mbs, acc, md, mc = inv_candidate(mbs, acc, md, mc, sender)
+            for sender in range(n):
+                mbs, acc, md, mc = point_candidate(mbs, acc, md, mc,
+                                                   sB0, 3, sender)
+                mbs, acc, md, mc = point_candidate(mbs, acc, md, mc,
+                                                   sB1, 4, sender)
 
         ob_valid_new = jnp.stack(
-            [jnp.stack(rej_valid[k], axis=0) for k in range(_NSLOTS)], axis=1
-        )                                                  # [N, 5, B]
-        ob_new = jnp.stack(
-            [
-                jnp.stack(
-                    [jnp.stack(rej_rows[r][k], axis=0) for k in range(_NSLOTS)],
-                    axis=1,
-                )
-                for r in range(_OB_NROWS)
-            ],
+            [jnp.stack(rej_valid[k], axis=0) for k in range(_NSLOTS)],
             axis=1,
-        )                                                  # [N, 7, 5, B]
+        )                                                  # [N, 5, B]
+        ob_recv_new = jnp.stack(
+            [jnp.stack(rej_recv[k], axis=0) for k in range(_NSLOTS)],
+            axis=1,
+        )
+        ob_new = [
+            jnp.stack(
+                [jnp.stack(rej_words[w][k], axis=0)
+                 for k in range(_NSLOTS)],
+                axis=1,
+            )
+            for w in range(W)
+        ]                                                  # W x [N, 5, B]
         blocked_next = jnp.sum(ob_valid_new, axis=1) > 0
 
         mb_count3 = count2 + acc
-        overflow_now = jnp.any(mb_count3 > cap, axis=0, keepdims=True)
+        ov_inc = jnp.minimum(
+            jnp.sum((mb_count3 > cap).astype(I32), axis=0, keepdims=True),
+            1,
+        )
+
+        out = {
+            "cachew": cachew, "dirw": dirw,
+            "mb_count": mb_count3, "pc": pc,
+            "waiting": waiting,
+            "pending_write": pending_write,
+            "ob_recv": ob_recv_new, "ob_valid": ob_valid_new,
+            "tr": s["tr"], "tr_len": s["tr_len"],
+        }
+        for w in range(W):
+            out[f"mb{w}"] = mbs[w]
+            out[f"ob{w}"] = ob_new[w]
 
         # ===== phase D: dump-at-local-completion snapshots ===========
-        done_node = (
-            (pc >= tr_len) & (waiting == 0) & (mb_count3 == 0) & ~blocked_next
-        )
-        snap_now = done_node & ~(s["snap_taken"] != 0)
-        s2 = snap_now[:, None, :]
-        snap_mem = jnp.where(s2, mem, s["snap_mem"])
-        snap_dir_state = jnp.where(s2, dir_state, s["snap_dir_state"])
-        snap_dir_sharers = jnp.where(s2, dir_sharers, s["snap_dir_sharers"])
-        snap_cache_addr = jnp.where(s2, cache_addr, s["snap_cache_addr"])
-        snap_cache_val = jnp.where(s2, cache_val, s["snap_cache_val"])
-        snap_cache_state = jnp.where(s2, cache_state, s["snap_cache_state"])
+        if snapshots:
+            done_node = (
+                (pc >= tr_len) & (waiting == 0) & (mb_count3 == 0)
+                & ~blocked_next
+            )
+            snap_now = done_node & ~(s["snap_taken"] != 0)
+            s2 = snap_now[:, None, :]
+            out["snap_taken"] = (
+                (s["snap_taken"] != 0) | done_node
+            ).astype(I32)
+            out["snap_cachew"] = jnp.where(s2, cachew, s["snap_cachew"])
+            out["snap_dirw"] = jnp.where(s2, dirw, s["snap_dirw"])
 
         # ===== counters ==============================================
         row = lambda x: jnp.sum(x.astype(I32), axis=0, keepdims=True)
@@ -652,7 +821,7 @@ def build_cycle(config: SystemConfig, bb: int):
             (_SC_CYCLE, jnp.ones((1, bb), I32)),
             (_SC_INSTR, row(elig)),
             (_SC_MSGS, md),
-            (_SC_OVERFLOW, overflow_now.astype(I32)),
+            (_SC_OVERFLOW, ov_inc),
             (_SC_RH, row(is_rd & hit)),
             (_SC_RM, row(rm)),
             (_SC_WH, row(is_wr & hit)),
@@ -665,34 +834,18 @@ def build_cycle(config: SystemConfig, bb: int):
         for rid, val in upd:
             inc = jnp.where(iota_sc == rid, val, inc)
         # overflow row is sticky-OR, everything else accumulates
-        sc = jnp.where(
+        out["scalars"] = jnp.where(
             iota_sc == _SC_OVERFLOW, jnp.maximum(sc, inc), sc + inc
         )
-
-        return {
-            "cache_addr": cache_addr, "cache_val": cache_val,
-            "cache_state": cache_state, "mem": mem,
-            "dir_state": dir_state, "dir_sharers": dir_sharers,
-            "mb": mb, "mb_count": mb_count3, "pc": pc,
-            "waiting": waiting,
-            "pending_write": pending_write,
-            "ob": ob_new, "ob_valid": ob_valid_new,
-            "snap_taken": ((s["snap_taken"] != 0) | done_node).astype(I32),
-            "snap_mem": snap_mem, "snap_dir_state": snap_dir_state,
-            "snap_dir_sharers": snap_dir_sharers,
-            "snap_cache_addr": snap_cache_addr,
-            "snap_cache_val": snap_cache_val,
-            "snap_cache_state": snap_cache_state,
-            "scalars": sc, "msg_counts": s["msg_counts"] + mc,
-            "tr_op": s["tr_op"], "tr_addr": s["tr_addr"],
-            "tr_val": s["tr_val"], "tr_len": s["tr_len"],
-        }
+        out["msg_counts"] = s["msg_counts"] + mc
+        return out
 
     return cycle
 
 
 def quiescent_block(s) -> jnp.ndarray:
-    """[B] bool: per-system quiescence in transposed layout."""
+    """[B] bool: per-system quiescence in transposed layout (host-side
+    readback; the in-kernel check is the integer form in ``body``)."""
     return (
         jnp.all(s["pc"] >= s["tr_len"], axis=0)
         & jnp.all(s["waiting"] == 0, axis=0)
@@ -705,101 +858,138 @@ def quiescent_block(s) -> jnp.ndarray:
 # Kernel wrapper + host runner
 # ---------------------------------------------------------------------------
 
-def _init_transposed(config: SystemConfig, tr_op, tr_addr, tr_val, tr_len):
-    """Initial state dict in transposed layout from [B, N, T] traces
+def _pack_traces(config: SystemConfig, tr_op, tr_addr, tr_val, tr_len):
+    """[B, N, T] op/addr/val arrays -> packed [N, T, B] word array.
+    Padding beyond tr_len is sanitized to zero (never fetched — the
+    pc < tr_len gate)."""
+    t = tr_op.shape[2]
+    valid = np.arange(t)[None, None, :] < tr_len[:, :, None]
+    opx = tr_op.astype(np.int64)
+    valx = tr_val.astype(np.int64)
+    addrx = tr_addr.astype(np.int64)
+    if valid.any():
+        if not ((opx[valid] >= 0) & (opx[valid] <= 1)).all():
+            raise ValueError("trace ops must be 0 (RD) or 1 (WR)")
+        if not ((valx[valid] >= 0) & (valx[valid] < 256)).all():
+            raise ValueError("trace values must be bytes (mod 256)")
+        if not (
+            (addrx[valid] >= 0) & (addrx[valid] < config.num_addresses)
+        ).all():
+            raise ValueError("trace addresses out of range")
+    tr = np.where(
+        valid, opx | (valx << 1) | (addrx << _TR_ADDR_SHIFT), 0
+    ).astype(np.int32)
+    return np.ascontiguousarray(np.moveaxis(tr, 0, -1))
+
+
+def _init_state(config: SystemConfig, b: int, snapshots: bool = True):
+    """Initial packed state dict in transposed layout
     (initializeProcessor semantics, assignment.c:776-822)."""
-    b, n, t = tr_op.shape
-    c, m, cap = config.cache_size, config.mem_size, config.msg_buffer_size
-    mem0 = np.broadcast_to(
-        np.array(
-            [[(20 * i + j) % 256 for j in range(m)] for i in range(n)],
-            dtype=np.int32,
-        )[:, :, None],
-        (n, m, b),
+    n, c, m = config.num_procs, config.cache_size, config.mem_size
+    cap = config.msg_buffer_size
+    _, W = _mb_layout(config)
+    _check_geometry(config)
+
+    mem0 = np.array(
+        [[(20 * i + j) % 256 for j in range(m)] for i in range(n)],
+        dtype=np.int32,
     )
-    mb0 = np.zeros((n, _NFIELD, cap, b), dtype=np.int32)
-    mb0[:, _F_TYPE] = -1
-    mb0[:, _F_SECOND] = -1
+    dirw0 = np.broadcast_to(
+        (mem0 | (_DU << _DW_STATE_SHIFT))[:, :, None], (n, m, b)
+    ).copy()
+    # invalid line: state I, value 0, addr -1 (stored +1 = 0)
+    cachew0 = np.full((n, c, b), _I, np.int32)
     z2 = np.zeros((n, b), dtype=np.int32)
     state = {
-        "cache_addr": np.full((n, c, b), _INVALID_ADDR, np.int32),
-        "cache_val": np.zeros((n, c, b), np.int32),
-        "cache_state": np.full((n, c, b), _I, np.int32),
-        "mem": mem0.copy(),
-        "dir_state": np.full((n, m, b), _DU, np.int32),
-        "dir_sharers": np.zeros((n, m, b), np.int32),
-        "mb": mb0,
+        "cachew": cachew0.copy(),
+        "dirw": dirw0,
         "mb_count": z2.copy(), "pc": z2.copy(),
         "waiting": z2.copy(), "pending_write": z2.copy(),
-        "ob": np.zeros((n, _OB_NROWS, _NSLOTS, b), np.int32),
+        "ob_recv": np.zeros((n, _NSLOTS, b), np.int32),
         "ob_valid": np.zeros((n, _NSLOTS, b), np.int32),
-        "snap_taken": z2.copy(),
-        "snap_mem": mem0.copy(),
-        "snap_dir_state": np.full((n, m, b), _DU, np.int32),
-        "snap_dir_sharers": np.zeros((n, m, b), np.int32),
-        "snap_cache_addr": np.full((n, c, b), _INVALID_ADDR, np.int32),
-        "snap_cache_val": np.zeros((n, c, b), np.int32),
-        "snap_cache_state": np.full((n, c, b), _I, np.int32),
         "scalars": np.zeros((_NSCALAR, b), np.int32),
         "msg_counts": np.zeros((_NTYPES, b), np.int32),
     }
-    traces = {
-        "tr_op": np.ascontiguousarray(
-            np.moveaxis(tr_op.astype(np.int32), 0, -1)),
-        "tr_addr": np.ascontiguousarray(
-            np.moveaxis(tr_addr.astype(np.int32), 0, -1)),
-        "tr_val": np.ascontiguousarray(
-            np.moveaxis(tr_val.astype(np.int32), 0, -1)),
-        "tr_len": np.ascontiguousarray(
-            np.moveaxis(tr_len.astype(np.int32), 0, 1)),
-    }
-    return state, traces
+    for w in range(W):
+        state[f"mb{w}"] = np.zeros((n, cap, b), np.int32)
+        state[f"ob{w}"] = np.zeros((n, _NSLOTS, b), np.int32)
+    if snapshots:
+        state.update({
+            "snap_taken": z2.copy(),
+            "snap_cachew": cachew0.copy(),
+            "snap_dirw": dirw0.copy(),
+        })
+    return state
 
 
 @functools.lru_cache(maxsize=16)
 def _build_call(config: SystemConfig, b: int, bb: int, k: int,
-                interpret: bool):
+                interpret: bool, snapshots: bool,
+                ablate: frozenset = frozenset(), gate: bool = True):
     """Jitted pallas_call advancing every system by up to ``k`` cycles
-    (quiesced blocks skip), state resident in VMEM for the duration."""
+    (quiesced blocks skip at ``_GATE`` granularity), state resident in
+    VMEM for the duration."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     if b % bb != 0:
         raise ValueError(f"batch {b} not divisible by block {bb}")
-    cycle = build_cycle(config, bb)
+    cycle = build_cycle(config, bb, snapshots, ablate)
     n, c, m = config.num_procs, config.cache_size, config.mem_size
     cap, nt = config.msg_buffer_size, _NTYPES
+    _, W = _mb_layout(config)
+    fields = _state_fields(W, snapshots)
+    outer, inner = -(-k // _GATE), _GATE
 
     shapes = {
-        "cache_addr": (n, c), "cache_val": (n, c), "cache_state": (n, c),
-        "mem": (n, m), "dir_state": (n, m), "dir_sharers": (n, m),
-        "mb": (n, _NFIELD, cap), "mb_count": (n,), "pc": (n,),
+        "cachew": (n, c), "dirw": (n, m),
+        "mb_count": (n,), "pc": (n,),
         "waiting": (n,), "pending_write": (n,),
-        "ob": (n, _OB_NROWS, _NSLOTS), "ob_valid": (n, _NSLOTS),
-        "snap_taken": (n,), "snap_mem": (n, m),
-        "snap_dir_state": (n, m), "snap_dir_sharers": (n, m),
-        "snap_cache_addr": (n, c), "snap_cache_val": (n, c),
-        "snap_cache_state": (n, c),
+        "ob_recv": (n, _NSLOTS), "ob_valid": (n, _NSLOTS),
+        "snap_taken": (n,), "snap_cachew": (n, c), "snap_dirw": (n, m),
         "scalars": (_NSCALAR,), "msg_counts": (nt,),
     }
+    for w in range(W):
+        shapes[f"mb{w}"] = (n, cap)
+        shapes[f"ob{w}"] = (n, _NSLOTS)
 
     def kernel(*refs):
         ntr = len(TRACE_FIELDS)
-        nst = len(STATE_FIELDS)
+        nst = len(fields)
         tr_refs = refs[:ntr]
         in_refs = refs[ntr:ntr + nst]
         out_refs = refs[ntr + nst:]
-        s = {name: in_refs[i][:] for i, name in enumerate(STATE_FIELDS)}
+        s = {name: in_refs[i][:] for i, name in enumerate(fields)}
         s.update(
             {name: tr_refs[i][:] for i, name in enumerate(TRACE_FIELDS)}
         )
 
-        def body(_, st):
-            done = jnp.all(quiescent_block(st))
-            return jax.lax.cond(done, lambda x: x, cycle, st)
+        def run_gate(st):
+            return jax.lax.fori_loop(
+                0, inner, lambda _, x: cycle(x), st
+            )
 
-        s = jax.lax.fori_loop(0, k, body, s)
-        for i, name in enumerate(STATE_FIELDS):
+        def body(_, st):
+            # integer quiescence check: bool-vector reductions are not
+            # Mosaic-lowerable (i8->i1 trunci), so count outstanding
+            # work and compare the scalar.  Checked once per _GATE
+            # cycles (the reduce+branch costs ~8.5us, measured).
+            active = (
+                jnp.sum(jnp.maximum(st["tr_len"] - st["pc"], 0))
+                + jnp.sum(st["waiting"])
+                + jnp.sum(st["mb_count"])
+                + jnp.sum(st["ob_valid"])
+            )
+            return jax.lax.cond(active == 0, lambda x: x, run_gate, st)
+
+        if gate:
+            s = jax.lax.fori_loop(0, outer, body, s)
+        else:
+            # no in-kernel early exit: the lax.cond doubles the live
+            # carry in VMEM; the host-level while_loop already bounds
+            # overshoot to < k cycles per quiesced block
+            s = jax.lax.fori_loop(0, k, lambda _, x: cycle(x), s)
+        for i, name in enumerate(fields):
             out_refs[i][:] = s[name]
 
     def block_spec(prefix_shape):
@@ -812,22 +1002,19 @@ def _build_call(config: SystemConfig, b: int, bb: int, k: int,
         )
 
     def call(state: Dict[str, jnp.ndarray], traces: Dict[str, jnp.ndarray]):
-        t_dim = traces["tr_op"].shape[1]
-        tr_shapes = {
-            "tr_op": (n, t_dim), "tr_addr": (n, t_dim),
-            "tr_val": (n, t_dim), "tr_len": (n,),
-        }
+        t_dim = traces["tr"].shape[1]
+        tr_shapes = {"tr": (n, t_dim), "tr_len": (n,)}
         in_specs = (
             [block_spec(tr_shapes[f]) for f in TRACE_FIELDS]
-            + [block_spec(shapes[f]) for f in STATE_FIELDS]
+            + [block_spec(shapes[f]) for f in fields]
         )
-        out_specs = [block_spec(shapes[f]) for f in STATE_FIELDS]
+        out_specs = [block_spec(shapes[f]) for f in fields]
         out_shape = [
             jax.ShapeDtypeStruct(tuple(shapes[f]) + (b,), jnp.int32)
-            for f in STATE_FIELDS
+            for f in fields
         ]
         aliases = {
-            len(TRACE_FIELDS) + i: i for i in range(len(STATE_FIELDS))
+            len(TRACE_FIELDS) + i: i for i in range(len(fields))
         }
         fn = pl.pallas_call(
             kernel,
@@ -839,12 +1026,71 @@ def _build_call(config: SystemConfig, b: int, bb: int, k: int,
             interpret=interpret,
         )
         args = [traces[f] for f in TRACE_FIELDS] + [
-            state[f] for f in STATE_FIELDS
+            state[f] for f in fields
         ]
         outs = fn(*args)
-        return dict(zip(STATE_FIELDS, outs))
+        return dict(zip(fields, outs))
 
     return jax.jit(call)
+
+
+@functools.lru_cache(maxsize=16)
+def _build_run(config: SystemConfig, b: int, bb: int, k: int,
+               interpret: bool, snapshots: bool, window: int, n_seg: int,
+               max_calls: int, ablate: frozenset = frozenset(),
+               gate: bool = True):
+    """One jitted program driving the WHOLE run on-device: fori over
+    trace windows x while-to-quiescence around the pallas_call, one
+    status scalar out.  Host<->device round trips through the axon
+    tunnel cost ~10^2 ms each (measured round 4); the per-call python
+    loop was paying two per 128 cycles, dwarfing the kernel itself."""
+    call = _build_call(config, b, bb, k, interpret, snapshots, ablate,
+                       gate)
+
+    def all_quiescent(st, tl):
+        return (
+            jnp.all(st["pc"] >= tl)
+            & jnp.all(st["waiting"] == 0)
+            & jnp.all(st["mb_count"] == 0)
+            & jnp.all(st["ob_valid"] == 0)
+        )
+
+    def run_all(state, tr_full, tr_len_full):
+        def seg_body(si, carry):
+            st, stalled = carry
+            tr_seg = jax.lax.dynamic_slice_in_dim(
+                tr_full, si * window, window, axis=1
+            )
+            tl_seg = jnp.clip(tr_len_full - si * window, 0, window)
+            # window base: every system is quiescent here (enforced
+            # below via the stalled flag), so the pc restart is a
+            # plain reset
+            st = {**st, "pc": jnp.zeros_like(st["pc"])}
+            traces = {"tr": tr_seg, "tr_len": tl_seg}
+
+            def cond(c):
+                s2, calls = c
+                return (~all_quiescent(s2, tl_seg)) & (calls < max_calls)
+
+            def body(c):
+                s2, calls = c
+                return call(s2, traces), calls + 1
+
+            st, _ = jax.lax.while_loop(cond, body, (st, jnp.int32(0)))
+            stalled = stalled | ~all_quiescent(st, tl_seg)
+            return st, stalled
+
+        state, stalled = jax.lax.fori_loop(
+            0, n_seg, seg_body, (state, jnp.bool_(False))
+        )
+        overflow = jnp.any(state["scalars"][_SC_OVERFLOW] > 0)
+        status = (
+            stalled.astype(jnp.int32)
+            | (overflow.astype(jnp.int32) << 1)
+        )
+        return state, status
+
+    return jax.jit(run_all)
 
 
 class PallasEngine:
@@ -854,6 +1100,15 @@ class PallasEngine:
     semantics, dump-at-local-completion snapshots, counters — at a
     fraction of the per-cycle cost.  ``interpret=True`` runs the
     kernel in the Pallas interpreter (CPU differential tests).
+    ``snapshots=False`` drops the phase-D snapshot planes from VMEM
+    (the bench configuration; final state and counters only).
+
+    ``trace_window=w`` runs traces longer than ``w`` as successive
+    windows of ``w`` instructions per core, quiescing between windows
+    — a legal schedule of the same per-node programs that keeps the
+    trace plane (the dominant VMEM tenant) bounded for arbitrarily
+    long workloads (the reference caps traces at 32 instructions,
+    assignment.c:13; this is the uncapped analog).
     """
 
     def __init__(
@@ -863,9 +1118,13 @@ class PallasEngine:
         tr_addr: np.ndarray,
         tr_val: np.ndarray,
         tr_len: np.ndarray,
-        block: int = 128,
+        block: int = 1024,
         cycles_per_call: int = 128,
         interpret: Optional[bool] = None,
+        snapshots: bool = True,
+        trace_window: Optional[int] = None,
+        gate: bool = True,
+        _ablate: frozenset = frozenset(),
     ):
         if interpret is None:
             # the Mosaic kernel path needs a TPU; interpret elsewhere
@@ -874,10 +1133,11 @@ class PallasEngine:
             interpret = not any(
                 "tpu" in str(d).lower() for d in jax.devices()
             )
-        b = tr_op.shape[0]
+        b, _, t = tr_op.shape
         self.config = config
         self.b = b
         self._interpret_active = interpret
+        self._snapshots = snapshots
         # largest divisor of the batch not exceeding the requested
         # block (the grid tiles the ensemble axis exactly)
         block = min(block, b)
@@ -885,74 +1145,132 @@ class PallasEngine:
             block -= 1
         self.block = block
         self.cycles_per_call = cycles_per_call
-        state, traces = _init_transposed(
-            config, tr_op, tr_addr, tr_val, tr_len
+
+        tr_len = tr_len.astype(np.int32)
+        packed = _pack_traces(config, tr_op, tr_addr, tr_val, tr_len)
+        w = trace_window if trace_window else t
+        w = max(1, min(w, t))
+        self._window = w
+        self._n_seg = -(-t // w)
+        if snapshots and self._n_seg > 1:
+            raise ValueError(
+                "dump-at-local-completion snapshots are defined on the "
+                "whole trace; run windowed traces with snapshots=False"
+            )
+        t_pad = self._n_seg * w
+        if t_pad != t:
+            packed = np.pad(packed, ((0, 0), (0, t_pad - t), (0, 0)))
+        self._tr_full = jnp.asarray(packed)
+        self._tr_len_full = jnp.asarray(
+            np.ascontiguousarray(np.moveaxis(tr_len, 0, 1))
         )
+        state = _init_state(config, b, snapshots)
         self.state = {f: jnp.asarray(v) for f, v in state.items()}
-        self.traces = {f: jnp.asarray(v) for f, v in traces.items()}
+        # first-window traces, for direct _call users (perf tooling)
+        self.traces = {
+            "tr": self._tr_full[:, :w, :],
+            "tr_len": jnp.clip(self._tr_len_full, 0, w),
+        }
+        self._ablate = _ablate
+        self._interpret = interpret
+        self._gate = gate
+        self._completed = False
+        self._poisoned = False
         self._call = _build_call(
-            config, b, self.block, cycles_per_call, interpret
+            config, b, self.block, cycles_per_call, interpret,
+            snapshots, _ablate, gate
         )
 
     def run(self, max_cycles: int = 1_000_000) -> "PallasEngine":
-        calls = 0
-        limit = max(1, -(-max_cycles // self.cycles_per_call))
-        while True:
-            self.state = self._call(self.state, self.traces)
-            calls += 1
-            if bool(jnp.any(self.state["scalars"][_SC_OVERFLOW] > 0)):
-                raise StallError(
-                    "internal invariant violated: mailbox overflow despite backpressure"
-                )
-            if bool(
-                jnp.all(
-                    quiescent_block(
-                        {**self.state, "tr_len": self.traces["tr_len"]}
-                    )
-                )
-            ):
-                return self
-            if calls >= limit:
-                raise StallError(
-                    f"no quiescence after ~{calls * self.cycles_per_call} "
-                    "cycles (livelock? use Semantics.robust())"
-                )
+        # the on-device driver resets pc at every window base, so a
+        # run is not resumable: completed runs are a no-op, stalled
+        # runs leave in-flight state that only a rebuild can clear
+        if self._completed:
+            return self
+        if self._poisoned:
+            raise StallError(
+                "engine state is mid-flight after a failed run; "
+                "rebuild the engine to retry"
+            )
+        max_calls = max(1, -(-max_cycles // self.cycles_per_call))
+        runner = _build_run(
+            self.config, self.b, self.block, self.cycles_per_call,
+            self._interpret, self._snapshots, self._window, self._n_seg,
+            max_calls, self._ablate, self._gate,
+        )
+        state, status = runner(
+            self.state, self._tr_full, self._tr_len_full
+        )
+        self.state = state
+        status = int(status)  # the run's single host sync
+        if status:
+            self._poisoned = True
+        if status & 2:
+            raise StallError(
+                "internal invariant violated: mailbox overflow despite "
+                "backpressure"
+            )
+        if status & 1:
+            raise StallError(
+                f"no quiescence within ~{max_cycles} cycles of a trace "
+                "window (livelock? use Semantics.robust())"
+            )
+        self._completed = True
+        return self
 
     # -- readback -----------------------------------------------------
 
-    def _dump(self, arrs, sys_idx: int) -> List[NodeDump]:
-        mem, dstate, dsh, caddr, cval, cstate = arrs
+    def _dump(self, cachew, dirw, sys_idx: int) -> List[NodeDump]:
+        n = self.config.num_procs
+        sh_mask = (1 << n) - 1
+        addr_mask = (1 << 21) - 1
         return [
             NodeDump(
                 proc_id=i,
-                memory=[int(x) for x in mem[i, :, sys_idx]],
-                dir_state=[int(x) for x in dstate[i, :, sys_idx]],
-                dir_sharers=[
-                    int(np.uint32(x)) for x in dsh[i, :, sys_idx]
+                memory=[int(x) for x in dirw[i, :, sys_idx] & 0xFF],
+                dir_state=[
+                    int(x)
+                    for x in (dirw[i, :, sys_idx] >> _DW_STATE_SHIFT) & 3
                 ],
-                cache_addr=[int(x) for x in caddr[i, :, sys_idx]],
-                cache_value=[int(x) for x in cval[i, :, sys_idx]],
-                cache_state=[int(x) for x in cstate[i, :, sys_idx]],
+                dir_sharers=[
+                    int(x)
+                    for x in (dirw[i, :, sys_idx] >> _DW_SH_SHIFT)
+                    & sh_mask
+                ],
+                cache_addr=[
+                    int(x) - 1
+                    for x in (cachew[i, :, sys_idx] >> _CW_ADDR_SHIFT)
+                    & addr_mask
+                ],
+                cache_value=[
+                    int(x)
+                    for x in (cachew[i, :, sys_idx] >> _CW_VAL_SHIFT)
+                    & 0xFF
+                ],
+                cache_state=[
+                    int(x) for x in cachew[i, :, sys_idx] & 3
+                ],
             )
-            for i in range(self.config.num_procs)
+            for i in range(n)
         ]
 
     def system_snapshots(self, sys_idx: int) -> List[NodeDump]:
-        arrs = tuple(
-            np.asarray(self.state[f])
-            for f in ("snap_mem", "snap_dir_state", "snap_dir_sharers",
-                      "snap_cache_addr", "snap_cache_val",
-                      "snap_cache_state")
+        if not self._snapshots:
+            raise ValueError(
+                "engine built with snapshots=False has no phase-D state"
+            )
+        return self._dump(
+            np.asarray(self.state["snap_cachew"]),
+            np.asarray(self.state["snap_dirw"]),
+            sys_idx,
         )
-        return self._dump(arrs, sys_idx)
 
     def system_final_dumps(self, sys_idx: int) -> List[NodeDump]:
-        arrs = tuple(
-            np.asarray(self.state[f])
-            for f in ("mem", "dir_state", "dir_sharers",
-                      "cache_addr", "cache_val", "cache_state")
+        return self._dump(
+            np.asarray(self.state["cachew"]),
+            np.asarray(self.state["dirw"]),
+            sys_idx,
         )
-        return self._dump(arrs, sys_idx)
 
     @property
     def instructions(self) -> int:

@@ -1,0 +1,154 @@
+"""Micro-benchmarks of the op patterns inside the Pallas cycle kernel,
+on real TPU — isolates where the per-cycle time goes.
+
+Each kernel runs K iterations of one pattern over a [*, B] block in
+VMEM and is timed per iteration.  Patterns:
+
+  empty     fori over identity cond with the full-size carry
+  deliver   J x (one-hot compare + select) on [N, cap, B)  (phase C)
+  rw        R x one-hot read + W x one-hot write over [N, M, B] (phase A)
+  scalar    the integer quiescence reduce + cond           (loop gate)
+  rowops    P x elementwise ops on [N, B] rows             (handler math)
+"""
+
+import functools
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+I32 = jnp.int32
+N, CAP, M, B = 8, 16, 16, 256
+K = 256
+J = 40
+
+
+def bench(name, kernel_body, arrs, grid=32):
+    """arrs: dict name -> np array [shape..., B*grid]."""
+    shapes = {k: v.shape[:-1] for k, v in arrs.items()}
+    names = list(arrs)
+
+    def kernel(*refs):
+        s = {nm: refs[i][:] for i, nm in enumerate(names)}
+        s = jax.lax.fori_loop(0, K, kernel_body, s)
+        for i, nm in enumerate(names):
+            refs[len(names) + i][:] = s[nm]
+
+    def spec(prefix):
+        shape = tuple(prefix) + (B,)
+        nd = len(shape)
+        return pl.BlockSpec(shape, (lambda i, _nd=nd: (0,) * (_nd - 1) + (i,)),
+                            memory_space=pltpu.VMEM)
+
+    total = B * grid
+    fn = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[spec(shapes[nm]) for nm in names],
+        out_specs=[spec(shapes[nm]) for nm in names],
+        out_shape=[jax.ShapeDtypeStruct(tuple(shapes[nm]) + (total,), jnp.int32)
+                   for nm in names],
+        input_output_aliases={i: i for i in range(len(names))},
+    )
+    f = jax.jit(lambda *a: fn(*a))
+    # donation (input_output_aliases) consumes buffers: fresh args per call
+    warm = [jnp.asarray(v) for v in arrs.values()]
+    out = f(*warm)
+    jax.block_until_ready(out)
+    timed = [jnp.asarray(v) for v in arrs.values()]
+    jax.block_until_ready(timed)
+    t0 = time.perf_counter()
+    out = f(*timed)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    checksum = int(jnp.sum(out[0]))
+    per_iter_us = dt / K / grid * 1e6
+    print(json.dumps({"name": name, "us_per_iter_per_block": round(per_iter_us, 3),
+                      "block_b": B, "grid": grid, "checksum": checksum,
+                      "total_s": round(dt, 4)}), flush=True)
+
+
+def main():
+    total = B * 32
+    rng = np.random.default_rng(0)
+    mb = rng.integers(0, 1 << 27, (N, CAP, total), dtype=np.int32)
+    mem = rng.integers(0, 256, (N, M, total), dtype=np.int32)
+    rows = rng.integers(0, 128, (N, total), dtype=np.int32)
+    cnt = np.zeros((N, total), np.int32)
+
+    # --- empty loop with carry ---------------------------------------
+    def empty(_, s):
+        return s
+
+    bench("empty", empty, {"mb": mb, "mem": mem, "rows": rows})
+
+    # --- cond-gated identity (the quiescence gate pattern) -----------
+    def scalar_gate(_, s):
+        active = jnp.sum(s["rows"]) + jnp.sum(s["cnt"])
+        return jax.lax.cond(active == 0, lambda x: x,
+                            lambda x: {k: v + 0 for k, v in x.items()}, s)
+
+    bench("scalar_gate", scalar_gate, {"rows": rows, "cnt": cnt})
+
+    # --- delivery pattern: J x (compare + select + small) ------------
+    def deliver(_, s):
+        mb_ = s["mb"]
+        acc = jnp.zeros((N, B), I32)
+        iota_cap = jax.lax.broadcasted_iota(I32, (N, CAP, B), 1)
+        iota_n = jax.lax.broadcasted_iota(I32, (N, B), 0)
+        cnt_ = s["cnt"]
+        for j in range(J):
+            recv = (s["rows"][j % N] + j) & 7
+            valid = ((s["rows"][(j + 1) % N] >> (j & 3)) & 1) == 1
+            valid_nb = valid[None, :] & (iota_n == recv[None, :])
+            pos = cnt_ + acc
+            accepted = valid_nb & (pos < CAP)
+            hot = (iota_cap == pos[:, None, :]) & accepted[:, None, :]
+            w = s["rows"][j % N] * 3 + j
+            mb_ = jnp.where(hot, w[None, None, :], mb_)
+            acc = acc + accepted.astype(I32)
+        return {"mb": mb_, "rows": s["rows"], "cnt": cnt_ + acc}
+
+    bench("deliver40", deliver, {"mb": mb, "rows": rows, "cnt": cnt})
+
+    # --- one-hot read/write over [N, M, B] (phase A state access) ----
+    def rw(_, s):
+        iota_m = jax.lax.broadcasted_iota(I32, (N, M, B), 1)
+        mem_ = s["mem"]
+        out_rows = s["rows"]
+        for r in range(6):
+            idx = (s["rows"] + r) & (M - 1)
+            val = jnp.sum(jnp.where(iota_m == idx[:, None, :], mem_, 0),
+                          axis=1)
+            out_rows = out_rows + val
+        for wri in range(3):
+            idx = (out_rows + wri) & (M - 1)
+            mask = (out_rows & 1) == 0
+            hot = (iota_m == idx[:, None, :]) & mask[:, None, :]
+            mem_ = jnp.where(hot, out_rows[:, None, :], mem_)
+        return {"mem": mem_, "rows": out_rows & 127}
+
+    bench("rw_9x", rw, {"mem": mem, "rows": rows})
+
+    # --- row ops: P elementwise ops on [N, B] ------------------------
+    def rowops(_, s):
+        x = s["rows"]
+        y = s["cnt"]
+        for p in range(100):
+            m_ = (x & 3) == (p & 3)
+            y = jnp.where(m_, y + x, y)
+            x = (x * 5 + 1) & 1023
+        return {"rows": x, "cnt": y}
+
+    bench("rowops300", rowops, {"rows": rows, "cnt": cnt})
+
+
+if __name__ == "__main__":
+    main()

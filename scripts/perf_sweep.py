@@ -1,0 +1,109 @@
+"""Quick on-TPU throughput measurement for the Pallas engine.
+
+Usage: python scripts/perf_sweep.py [batch instrs block cycles_per_call]
+Prints one JSON line per configuration.
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def measure(batch, instrs, block, k, cap=16, window=32, gate=1, seed=0, ablate=frozenset()):
+    from hpa2_tpu.config import Semantics, SystemConfig
+    from hpa2_tpu.ops.pallas_engine import PallasEngine
+    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+    config = SystemConfig(
+        num_procs=8, msg_buffer_size=cap, semantics=Semantics().robust()
+    )
+    arrays = gen_uniform_random_arrays(config, batch, instrs, seed=seed)
+    eng = PallasEngine(config, *arrays, block=block, cycles_per_call=k,
+                       snapshots=False, trace_window=window,
+                       gate=bool(gate), _ablate=ablate)
+    t0 = time.perf_counter()
+    eng.run()
+    warm_dt = time.perf_counter() - t0
+    eng2 = PallasEngine(config, *arrays, block=block, cycles_per_call=k,
+                        snapshots=False, trace_window=window,
+                       gate=bool(gate), _ablate=ablate)
+    t0 = time.perf_counter()
+    eng2.run()
+    dt = time.perf_counter() - t0
+    import numpy as np
+    cycles = int(np.max(np.asarray(eng2.state["scalars"])[0]))
+    print(json.dumps({
+        "batch": batch, "instrs_per_core": instrs, "block": block, "cap": cap,
+        "cycles_per_call": k, "window": window, "gate": gate, "instructions": eng2.instructions,
+        "seconds": round(dt, 4), "warm_seconds": round(warm_dt, 1),
+        "ops_per_sec": round(eng2.instructions / dt, 1),
+        "cycles": cycles,
+        "us_per_cycle": round(dt / cycles * 1e6, 2),
+    }), flush=True)
+
+
+def measure_ablate(batch, instrs, block, k, cap, window, names):
+    """Time ablated (semantically wrong) kernels from FRESH state (all
+    systems active for the whole call) and separately time the host
+    readbacks the run loop performs per call."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from hpa2_tpu.config import Semantics, SystemConfig
+    from hpa2_tpu.ops.pallas_engine import (
+        PallasEngine, _SC_CYCLE, quiescent_block,
+    )
+    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+    config = SystemConfig(
+        num_procs=8, msg_buffer_size=cap, semantics=Semantics().robust()
+    )
+    arrays = gen_uniform_random_arrays(config, batch, instrs, seed=0)
+
+    def fresh():
+        return PallasEngine(config, *arrays, block=block,
+                            cycles_per_call=k, snapshots=False,
+                            trace_window=window,
+                            _ablate=frozenset(names))
+
+    eng = fresh()
+    out = eng._call(eng.state, eng.traces)   # compile+warm
+    jax.block_until_ready(list(out.values()))
+
+    eng2 = fresh()
+    jax.block_until_ready(list(eng2.state.values()))
+    t0 = time.perf_counter()
+    out = eng2._call(eng2.state, eng2.traces)
+    jax.block_until_ready(list(out.values()))
+    t1 = time.perf_counter()
+    # the two host readbacks the run loop does per call
+    _ = bool(jnp.any(out["scalars"][3] > 0))
+    t2 = time.perf_counter()
+    _ = bool(jnp.all(quiescent_block({**out, "tr_len": eng2.traces["tr_len"]})))
+    t3 = time.perf_counter()
+    cyc = int(np.max(np.asarray(out["scalars"][_SC_CYCLE])))
+    print(json.dumps({"ablate": sorted(names), "batch": batch,
+                      "block": block, "cap": cap, "window": window,
+                      "call_s": round(t1 - t0, 4),
+                      "cycles_run": cyc,
+                      "us_per_cycle": round((t1 - t0) / max(cyc, 1) * 1e6, 2),
+                      "readback_overflow_s": round(t2 - t1, 4),
+                      "readback_quiescent_s": round(t3 - t2, 4)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    if sys.argv[1:2] == ["--ablate"]:
+        names = [a for a in sys.argv[2:] if not a.isdigit()]
+        nums = [int(a) for a in sys.argv[2:] if a.isdigit()]
+        batch, instrs, block, k, cap, window = (
+            nums + [8192, 128, 512, 128, 16, 32][len(nums):])
+        measure_ablate(batch, instrs, block, k, cap, window, names)
+    else:
+        args = [int(x) for x in sys.argv[1:]]
+        if args:
+            measure(*args)
+        else:
+            measure(8192, 128, 128, 128)

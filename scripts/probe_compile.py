@@ -14,23 +14,21 @@ def main() -> int:
     import numpy as np
 
     from hpa2_tpu.config import Semantics, SystemConfig
-    from hpa2_tpu.ops import pallas_engine as pe
+    from hpa2_tpu.ops.pallas_engine import PallasEngine
 
     config = SystemConfig(
-        num_procs=8, msg_buffer_size=32, semantics=Semantics().robust()
+        num_procs=8, msg_buffer_size=16, semantics=Semantics().robust()
     )
-    b, bb, k = 128, 128, 8
-    tr_op = np.zeros((b, 8, 16), np.int32)
-    tr_addr = np.zeros((b, 8, 16), np.int32)
-    tr_val = np.zeros((b, 8, 16), np.int32)
-    tr_len = np.full((b, 8), 16, np.int32)
-    state, traces = pe._init_transposed(config, tr_op, tr_addr, tr_val, tr_len)
-    state = {f: jax.numpy.asarray(v) for f, v in state.items()}
-    traces = {f: jax.numpy.asarray(v) for f, v in traces.items()}
-    call = pe._build_call(config, b, bb, k, False)
+    b, t = 1024, 16
+    tr_op = np.zeros((b, 8, t), np.int32)
+    tr_addr = np.zeros((b, 8, t), np.int32)
+    tr_val = np.zeros((b, 8, t), np.int32)
+    tr_len = np.full((b, 8), t, np.int32)
+    eng = PallasEngine(config, tr_op, tr_addr, tr_val, tr_len,
+                       cycles_per_call=8, interpret=False,
+                       snapshots=False)
     t0 = time.time()
-    lowered = call.lower(state, traces)
-    compiled = lowered.compile()
+    eng._call.lower(eng.state, eng.traces).compile()
     dt = time.time() - t0
     print(json.dumps({"ok": True, "compile_s": round(dt, 1),
                       "platform": jax.devices()[0].platform}))

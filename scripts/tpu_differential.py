@@ -31,30 +31,33 @@ def main() -> int:
     run = build_batched_run(config, max_cycles=100_000)
     out = run(state)
 
-    mism = []
-    pairs = [
-        ("mem", out.mem), ("dir_state", out.dir_state),
-        ("cache_addr", out.cache_addr), ("cache_val", out.cache_val),
-        ("cache_state", out.cache_state),
-    ]
-    for name, xla_arr in pairs:
-        # XLA layout [B, N, ...] -> transposed [N, ..., B]
-        x = np.moveaxis(np.asarray(xla_arr), 0, -1)
-        p = np.asarray(eng.state[name])
-        if x.shape != p.shape:
-            x = x.reshape(p.shape)
-        if not np.array_equal(x, p):
-            mism.append(name)
-    x_sh = np.moveaxis(np.asarray(out.dir_sharers), 0, -1)[:, :, 0, :]
-    if not np.array_equal(x_sh, np.asarray(eng.state["dir_sharers"])):
-        mism.append("dir_sharers")
+    mem = np.asarray(out.mem)
+    dstate = np.asarray(out.dir_state)
+    dsh = np.asarray(out.dir_sharers)[:, :, :, 0]
+    caddr = np.asarray(out.cache_addr)
+    cval = np.asarray(out.cache_val)
+    cstate = np.asarray(out.cache_state)
+
+    mism = 0
+    for b in range(batch):
+        for nd in eng.system_final_dumps(b):
+            i = nd.proc_id
+            okv = (
+                nd.memory == [int(x) for x in mem[b, i]]
+                and nd.dir_state == [int(x) for x in dstate[b, i]]
+                and nd.dir_sharers == [int(x) for x in dsh[b, i]]
+                and nd.cache_addr == [int(x) for x in caddr[b, i]]
+                and nd.cache_value == [int(x) for x in cval[b, i]]
+                and nd.cache_state == [int(x) for x in cstate[b, i]]
+            )
+            mism += 0 if okv else 1
     xi = int(jnp.sum(out.n_instr))
     pi = eng.instructions
-    if xi != pi:
-        mism.append(f"instr {xi} vs {pi}")
-    print(json.dumps({"ok": not mism, "mismatches": mism,
-                      "instructions": pi, "batch": batch}))
-    return 0 if not mism else 1
+    ok = mism == 0 and xi == pi
+    print(json.dumps({"ok": ok, "node_mismatches": mism,
+                      "instr_xla": xi, "instr_pallas": pi,
+                      "batch": batch}))
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

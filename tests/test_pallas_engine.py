@@ -101,3 +101,47 @@ def test_pallas_tiny_capacity_backpressures():
         interpret=True,
     ).run(max_cycles=100_000)
     assert pe.instructions == 2 * 8 * 64
+
+
+def test_pallas_trace_window_matches_spec_segmented():
+    """The bench configuration — trace_window segmentation, gate=False,
+    snapshots=False — against the spec engine run on the same window
+    schedule (SpecEngine.continue_with).  Gates the exact path the
+    perf numbers are measured on."""
+    from hpa2_tpu.models.spec_engine import SpecEngine
+
+    cfg = SystemConfig(
+        num_procs=8, msg_buffer_size=16, semantics=Semantics().robust()
+    )
+    batch, t, w = 4, 40, 16
+    op, addr, val, length = gen_uniform_random_arrays(cfg, batch, t, seed=9)
+    pe = PallasEngine(
+        cfg, op, addr, val, length, block=2, cycles_per_call=32,
+        interpret=True, snapshots=False, gate=False, trace_window=w,
+    ).run()
+
+    total_instr = 0
+    for b in range(batch):
+        traces = _traces_from_arrays(op, addr, val, b, 8)
+        spec = SpecEngine(cfg, [tr[:w] for tr in traces])
+        spec.run()
+        for s in range(w, t, w):
+            spec.continue_with([tr[s:s + w] for tr in traces])
+            spec.run()
+        assert _dicts(spec.final_dumps()) == _dicts(
+            pe.system_final_dumps(b)
+        )
+        total_instr += spec.instructions
+    assert total_instr == pe.instructions
+
+
+def test_pallas_run_idempotent_and_not_resumable():
+    cfg = SystemConfig(
+        num_procs=4, msg_buffer_size=16, semantics=Semantics().robust()
+    )
+    op, addr, val, length = gen_uniform_random_arrays(cfg, 2, 8, seed=1)
+    pe = PallasEngine(cfg, op, addr, val, length, block=2,
+                      cycles_per_call=32, interpret=True).run()
+    before = pe.instructions
+    pe.run()  # no-op: counters must not double
+    assert pe.instructions == before
