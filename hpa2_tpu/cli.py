@@ -171,6 +171,7 @@ def cmd_run(args) -> int:
 def cmd_bench(args) -> int:
     config = _build_config(args)
     _check_shard_args(args)
+    resumed_instrs = 0  # nonzero only when a checkpointed run resumes
     if args.data_shards > 1 and args.batch <= 1:
         raise SystemExit(
             "--data-shards > 1 needs --batch > 1 (an ensemble to "
@@ -318,8 +319,12 @@ def cmd_bench(args) -> int:
             )
             vq = jax.vmap(quiescent)
             jax.block_until_ready(run_chunk(state))  # warmup/compile
-            t0 = time.perf_counter()
             out = state
+            # work already in the checkpoint must not count toward
+            # this process's measured rate (read back before the clock
+            # starts: the sum forces a device round trip)
+            resumed_instrs = int(jnp.sum(out.n_instr))
+            t0 = time.perf_counter()
             k = int(jnp.max(out.cycle)) // args.checkpoint_every
             while not bool(jnp.all(vq(out))):
                 if bool(jnp.any(out.overflow)):
@@ -333,6 +338,26 @@ def cmd_bench(args) -> int:
                 k += 1
                 save_state(os.path.join(ckdir, f"ckpt_{k}.npz"), out,
                            config, extra_meta=workload_meta)
+                # GC during the run: keep the newest two (the previous
+                # one guards against a crash mid-write of the newest);
+                # tolerate foreign ckpt_*.npz names like
+                # latest_checkpoint does
+                def _ck_seq(nm):
+                    try:
+                        return int(nm[5:-4])
+                    except ValueError:
+                        return None
+
+                stale = sorted(
+                    (
+                        nm for nm in os.listdir(ckdir)
+                        if nm.startswith("ckpt_") and nm.endswith(".npz")
+                        and _ck_seq(nm) is not None
+                    ),
+                    key=_ck_seq,
+                )[:-2]
+                for old in stale:
+                    os.remove(os.path.join(ckdir, old))
             dt = time.perf_counter() - t0
             # completed: clear the checkpoints so a rerun starts fresh
             # instead of instantly "resuming" the quiescent final state
@@ -349,7 +374,7 @@ def cmd_bench(args) -> int:
             jnp.all(jax.vmap(quiescent)(out))
         ):
             raise StallError("batch did not reach quiescence")
-        instrs = int(jnp.sum(out.n_instr))
+        instrs = int(jnp.sum(out.n_instr)) - resumed_instrs
     else:
         from hpa2_tpu.ops.engine import JaxEngine
 
@@ -371,6 +396,7 @@ def cmd_bench(args) -> int:
                 "node_shards": args.node_shards,
                 "data_shards": args.data_shards,
                 "instrs": instrs,
+                "resumed_instrs": resumed_instrs,
                 "seconds": round(dt, 4),
                 "ops_per_sec": round(instrs / dt, 1),
             }

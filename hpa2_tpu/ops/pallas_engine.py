@@ -259,7 +259,6 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         iota_c = jax.lax.broadcasted_iota(I32, (n, c, bb), 1)
         iota_m = jax.lax.broadcasted_iota(I32, (n, m, bb), 1)
         iota_cap = jax.lax.broadcasted_iota(I32, (n, cap, bb), 1)
-        iota_t = jax.lax.broadcasted_iota(I32, (_NTYPES, bb), 0)
 
         def read_c(arr, idx):  # [N,C,B] by [N,B] -> [N,B]
             return jnp.sum(
@@ -669,117 +668,120 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         # NOTE a fully vectorized [J, N, B] formulation (cumsum over
         # the candidate axis) measured 2.4x SLOWER on v5e than this
         # per-candidate loop of small ops — fat 3D temporaries cost
-        # more than the saved op dispatch.
-        mbs = qdata
-        acc = zero  # running enqueue offset per receiver
-        md = jnp.zeros((1, bb), dtype=I32)
-        mc = jnp.zeros((_NTYPES, bb), dtype=I32)
-        # rejected-candidate collectors: [slot][sender] -> [B] rows
-        rej_valid = [[None] * n for _ in range(_NSLOTS)]
-        rej_recv = [[None] * n for _ in range(_NSLOTS)]
-        rej_words = [
-            [[None] * n for _ in range(_NSLOTS)] for _ in range(W)
+        # more than the saved op dispatch.  Encoding and bookkeeping
+        # ARE hoisted: per-slot encodes before the loop, stacked
+        # counter/rejection sums after it (order-free), leaving only
+        # position/acceptance/write ops inside.
+        aux_w, aux_off, _ = layout["aux"]
+        neg1_nb = jnp.full((n, bb), -1, I32)
+        sinv = {
+            "type": jnp.full((n, bb), int(MsgType.INV), I32),
+            "addr": inv_addr, "aux": zero, "second": neg1_nb,
+            "recv": neg1_nb,
+        }
+        slots5 = (sA0, sA1, sinv, sB0, sB1)
+        # per-slot packed words [N, B] (sender = node index)
+        words5 = [
+            enc(sl["type"], iota_n, sl["second"], sl["addr"], sl["aux"])
+            for sl in slots5
         ]
 
-        def deliver(mbs, acc, md, mc, valid_nb, type_v, words):
-            """Enqueue one candidate (packed words are [B] rows).
-            Returns the accepted [N, B] mask as well."""
+        mbs = qdata
+        acc = zero  # running enqueue offset per receiver
+        # accepted-receiver masks per candidate: [slot][sender] -> [N, B]
+        acc_masks = [[None] * n for _ in range(_NSLOTS)]
+
+        def candidate(mbs, acc, k, sender, valid_nb):
             pos = count2 + acc
             accepted = valid_nb & (pos < cap)
             hot = (iota_cap == pos[:, None, :]) & accepted[:, None, :]
             mbs = [
-                jnp.where(hot, words[w][None, None, :], mbs[w])
+                jnp.where(hot, words5[k][w][sender][None, None, :],
+                          mbs[w])
                 for w in range(W)
             ]
-            dcount = jnp.sum(accepted.astype(I32), axis=0, keepdims=True)
-            md = md + dcount
-            mc = mc + jnp.where(iota_t == type_v[None, :], dcount, 0)
-            return mbs, acc + accepted.astype(I32), md, mc, accepted
+            acc_masks[k][sender] = accepted
+            return mbs, acc + accepted.astype(I32)
 
-        def point_candidate(mbs, acc, md, mc, sl, k, sender):
-            valid_s = sl["valid"][sender]                  # [B]
-            recv_s = sl["recv"][sender]
-            valid_nb = valid_s[None, :] & (iota_n == recv_s[None, :])
-            type_v = sl["type"][sender]
-            words = enc(type_v, jnp.full((bb,), sender, I32),
-                        sl["second"][sender], sl["addr"][sender],
-                        sl["aux"][sender])
-            mbs, acc, md, mc, accepted = deliver(
-                mbs, acc, md, mc, valid_nb, type_v, words
+        def point_valid(sl, sender):
+            return sl["valid"][sender][None, :] & (
+                iota_n == sl["recv"][sender][None, :]
             )
-            rejected = valid_s & (
-                jnp.sum(accepted.astype(I32), axis=0) == 0
-            )
-            rej_valid[k][sender] = rejected.astype(I32)
-            rej_recv[k][sender] = recv_s
-            for w in range(W):
-                rej_words[w][k][sender] = words[w]
-            return mbs, acc, md, mc
 
-        aux_w, aux_off, _ = layout["aux"]
-
-        def inv_candidate(mbs, acc, md, mc, sender):
-            mask_s = inv_sharers[sender]                   # [B]
-            valid_nb = ((mask_s[None, :] >> iota_n) & 1) == 1
-            type_v = jnp.full((bb,), int(MsgType.INV), I32)
-            addr_s = inv_addr[sender]
-            zb = jnp.zeros((bb,), I32)
-            words = enc(type_v, jnp.full((bb,), sender, I32),
-                        jnp.full((bb,), -1, I32), addr_s, zb)
-            mbs, acc, md, mc, accepted = deliver(
-                mbs, acc, md, mc, valid_nb, type_v, words
-            )
-            remaining = mask_s & ~jnp.sum(
-                accepted.astype(I32) << iota_n, axis=0
-            )
-            rej_valid[2][sender] = (remaining != 0).astype(I32)
-            rej_recv[2][sender] = jnp.full((bb,), -1, I32)
-            # the *remaining* INV mask rides the (otherwise zero) aux
-            # field of the deferred word
-            for w in range(W):
-                rej_words[w][2][sender] = (
-                    words[w] | (remaining << aux_off)
-                    if w == aux_w else words[w]
-                )
-            return mbs, acc, md, mc
+        def inv_valid(sender):
+            return ((inv_sharers[sender][None, :] >> iota_n) & 1) == 1
 
         if "deliver" in ablate:
-            zrow = jnp.zeros((bb,), I32)
             for k_ in range(_NSLOTS):
                 for sender in range(n):
-                    rej_valid[k_][sender] = zrow
-                    rej_recv[k_][sender] = zrow
-                    for w in range(W):
-                        rej_words[w][k_][sender] = zrow
+                    acc_masks[k_][sender] = false
         else:
             for sender in range(n):
-                mbs, acc, md, mc = point_candidate(mbs, acc, md, mc,
-                                                   sA0, 0, sender)
-                mbs, acc, md, mc = point_candidate(mbs, acc, md, mc,
-                                                   sA1, 1, sender)
-                mbs, acc, md, mc = inv_candidate(mbs, acc, md, mc, sender)
+                mbs, acc = candidate(mbs, acc, 0, sender,
+                                     point_valid(sA0, sender))
+                mbs, acc = candidate(mbs, acc, 1, sender,
+                                     point_valid(sA1, sender))
+                mbs, acc = candidate(mbs, acc, 2, sender,
+                                     inv_valid(sender))
             for sender in range(n):
-                mbs, acc, md, mc = point_candidate(mbs, acc, md, mc,
-                                                   sB0, 3, sender)
-                mbs, acc, md, mc = point_candidate(mbs, acc, md, mc,
-                                                   sB1, 4, sender)
+                mbs, acc = candidate(mbs, acc, 3, sender,
+                                     point_valid(sB0, sender))
+                mbs, acc = candidate(mbs, acc, 4, sender,
+                                     point_valid(sB1, sender))
 
+        # post-loop bookkeeping on stacked masks (sums are order-free)
+        accs = jnp.stack(
+            [jnp.stack(acc_masks[k], axis=0) for k in range(_NSLOTS)],
+            axis=1,
+        ).astype(I32)                          # [S(sender), 5, R(recv), B]
+        dcount = jnp.sum(accs, axis=2)         # [S, 5, B] per candidate
+        md = jnp.sum(dcount, axis=(0, 1))[None, :]          # [1, B]
+        type_arr = jnp.stack(
+            [sl["type"] for sl in slots5], axis=1
+        )                                      # [S, 5, B]
+        mc = jnp.sum(
+            jnp.where(
+                type_arr[None, :, :, :] == jax.lax.broadcasted_iota(
+                    I32, (_NTYPES, n, _NSLOTS, bb), 0
+                ),
+                dcount[None, :, :, :], 0,
+            ),
+            axis=(1, 2),
+        )                                      # [NTYPES, B]
+
+        # rejected candidates defer to the sender outbox; the INV
+        # remainder (mask minus accepted receivers) rides the deferred
+        # word's aux field
+        io_r = jax.lax.broadcasted_iota(I32, (n, n, bb), 1)
+        inv_acc_bits = jnp.sum(accs[:, 2, :, :] << io_r, axis=1)
+        remaining = inv_sharers & ~inv_acc_bits
+        rej = [
+            slots5[k]["valid"].astype(I32)
+            * (dcount[:, k, :] == 0).astype(I32)
+            for k in (0, 1, 3, 4)
+        ]
         ob_valid_new = jnp.stack(
-            [jnp.stack(rej_valid[k], axis=0) for k in range(_NSLOTS)],
-            axis=1,
-        )                                                  # [N, 5, B]
+            [rej[0], rej[1], (remaining != 0).astype(I32),
+             rej[2], rej[3]], axis=1,
+        )                                      # [N, 5, B]
         ob_recv_new = jnp.stack(
-            [jnp.stack(rej_recv[k], axis=0) for k in range(_NSLOTS)],
-            axis=1,
+            [sA0["recv"], sA1["recv"], neg1_nb,
+             sB0["recv"], sB1["recv"]], axis=1,
         )
-        ob_new = [
-            jnp.stack(
-                [jnp.stack(rej_words[w][k], axis=0)
-                 for k in range(_NSLOTS)],
-                axis=1,
-            )
-            for w in range(W)
-        ]                                                  # W x [N, 5, B]
+        ob_new = []
+        for w in range(W):
+            ws = [words5[k][w] for k in range(_NSLOTS)]
+            if w == aux_w:
+                ws[2] = ws[2] | (remaining << aux_off)
+            ob_new.append(jnp.stack(ws, axis=1))
+        if "deliver" in ablate:
+            # timing fiction, matching the pre-hoist ablation: sends
+            # vanish without deferral (otherwise every candidate would
+            # defer and block issue, and the outbox ops would stay in
+            # the ablated graph instead of constant-folding away)
+            z5 = jnp.zeros((n, _NSLOTS, bb), I32)
+            ob_valid_new, ob_recv_new = z5, z5
+            ob_new = [z5 for _ in range(W)]
         blocked_next = jnp.sum(ob_valid_new, axis=1) > 0
 
         mb_count3 = count2 + acc

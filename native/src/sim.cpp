@@ -652,11 +652,15 @@ RunResult run_omp(const Config& cfg,
   std::vector<IssueRecord> order_buf(total_instrs);
   std::atomic<uint64_t> issue_seq{0};
   std::atomic<bool> aborted{false};  // livelock watchdog (the
-  // reference spins forever on this class; SURVEY.md §6.3)
+  // reference spins forever on this class; SURVEY.md §6.3).
+  // Wall-clock deadline, not a yield count: sched_yield() latency
+  // varies ~1000x with core count and load, so a spin budget is
+  // seconds on one box and minutes on another.
+  constexpr double kWatchdogSeconds = 10.0;
 
   auto send = [&](int recv, const Msg& m) {
     inflight.fetch_add(1, std::memory_order_relaxed);
-    uint64_t spins = 0;
+    double spin_start = -1.0;
     for (;;) {
       omp_set_lock(&box[recv].lock);
       if (box[recv].count < cfg.cap) break;
@@ -664,7 +668,9 @@ RunResult run_omp(const Config& cfg,
       // reference busy-waits with usleep, c:715-724)
       // watchdog: with tiny capacities blocked senders can deadlock
       // cyclically (the reference would spin forever here)
-      if (++spins > 2'000'000ull)
+      double now = omp_get_wtime();
+      if (spin_start < 0) spin_start = now;
+      if (now - spin_start > kWatchdogSeconds)
         aborted.store(true, std::memory_order_relaxed);
       if (aborted.load(std::memory_order_relaxed)) {
         inflight.fetch_sub(1, std::memory_order_relaxed);
@@ -694,7 +700,7 @@ RunResult run_omp(const Config& cfg,
     std::vector<bool> counted_done(hi - lo, false);
     std::vector<bool> snapped(hi - lo, false);
     uint64_t my_instrs = 0, my_msgs = 0;
-    uint64_t idle_spins = 0;
+    double idle_start = -1.0;
 
     auto csend = [&](int recv, const Msg& m) {
       ++my_msgs;
@@ -752,11 +758,13 @@ RunResult run_omp(const Config& cfg,
         break;
 
       if (progressed) {
-        idle_spins = 0;
+        idle_start = -1.0;
       } else {
         // idle: let peers run (critical when oversubscribed) and
         // watchdog the reference's livelock class (SURVEY.md §6.3)
-        if (++idle_spins > 2'000'000ull) {
+        double now = omp_get_wtime();
+        if (idle_start < 0) idle_start = now;
+        if (now - idle_start > kWatchdogSeconds) {
           aborted.store(true, std::memory_order_relaxed);
           break;
         }
@@ -773,11 +781,13 @@ RunResult run_omp(const Config& cfg,
                            order_buf.begin() + issue_seq.load());
   res.counters.instructions = instr_total.load();
   res.counters.messages = msg_total.load();
-  for (int i = 0; i < N; ++i) res.finals.push_back(nodes[i].dump());
   if (aborted.load()) {
+    // no finals: threads were torn down mid-protocol, so node state
+    // is not a consistent quiescent snapshot
     res.error = "livelock watchdog fired (stale intervention dropped; "
                 "use --robust)";
   } else {
+    for (int i = 0; i < N; ++i) res.finals.push_back(nodes[i].dump());
     res.completed = true;
   }
   return res;

@@ -125,7 +125,15 @@ def test_cli_bench_resumes_from_mid_checkpoint(tmp_path, capsys):
         str(batch), "--instrs", str(instrs), "--robust",
         "--checkpoint-every", "10", "--checkpoint-dir", str(ckdir),
     ]) == 0
-    assert "resumed from" in capsys.readouterr().err
+    cap = capsys.readouterr()
+    assert "resumed from" in cap.err
+    # measured rate covers only post-resume work: the checkpointed
+    # instructions are reported separately, not folded into ops/sec
+    import json as _json
+
+    rec = _json.loads(cap.out.strip().splitlines()[-1])
+    assert rec["resumed_instrs"] == int(np.sum(np.asarray(st.n_instr)))
+    assert rec["instrs"] == batch * 4 * instrs - rec["resumed_instrs"]
 
 
 def test_cli_bench_rejects_mismatched_checkpoint(tmp_path):
