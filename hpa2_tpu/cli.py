@@ -94,6 +94,7 @@ def cmd_run(args) -> int:
             final_dump=args.final_dump,
             max_cycles=args.max_cycles,
             record_order_path=args.record_order,
+            msg_trace_path=args.trace_msgs,
         )
         print(
             f"[omp] {res.instructions} instrs, {res.messages} msgs, "
@@ -111,14 +112,23 @@ def cmd_run(args) -> int:
     if args.backend == "spec":
         from hpa2_tpu.models.spec_engine import SpecEngine
 
-        eng = SpecEngine(config, traces, replay_order=replay)
+        eng = SpecEngine(config, traces, replay_order=replay,
+                         trace_msgs=bool(args.trace_msgs))
         eng.run(max_cycles=args.max_cycles)
+        if args.trace_msgs:
+            with open(args.trace_msgs, "w") as f:
+                f.writelines(line + "\n" for line in eng.msg_log)
         if args.record_order:
             from hpa2_tpu.utils.trace import format_instruction_order
 
             with open(args.record_order, "w") as f:
                 f.write(format_instruction_order(eng.issue_log))
     else:
+        if args.trace_msgs:
+            raise SystemExit(
+                "--trace-msgs is supported by the spec and omp "
+                "backends (the jax engines run entirely on device)"
+            )
         if args.record_order:
             raise SystemExit(
                 "--record-order is supported by the spec and omp "
@@ -458,6 +468,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     rp.add_argument("--out", help="output directory (default: CWD)")
     rp.add_argument(
         "--replay", help="instruction_order.txt to replay", default=None
+    )
+    rp.add_argument(
+        "--trace-msgs", metavar="PATH", default=None,
+        help="write a per-message send/receive log in the reference's "
+             "DEBUG_MSG format (assignment.c:170-174, 734-738); spec "
+             "and omp backends",
     )
     rp.add_argument(
         "--record-order", default=None, metavar="PATH",

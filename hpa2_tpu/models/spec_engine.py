@@ -144,6 +144,7 @@ class SpecEngine:
         traces: Sequence[Sequence[Instr]],
         replay_order: Optional[Sequence[IssueRecord]] = None,
         replay_batched: bool = False,
+        trace_msgs: bool = False,
     ):
         if len(traces) != config.num_procs:
             raise ValueError("need one trace per node")
@@ -167,6 +168,12 @@ class SpecEngine:
         # the executed issue interleaving, one IssueRecord per issued
         # instruction — the DEBUG_INSTR log (assignment.c:596-597)
         self.issue_log: List[IssueRecord] = []
+        # per-message send/receive log in the reference's DEBUG_MSG
+        # format (assignment.c:170-174 receive, 734-738 send); sends
+        # log at mailbox enqueue (the sendMessage analog), receives at
+        # dequeue
+        self.trace_msgs = trace_msgs
+        self.msg_log: List[str] = []
 
     @property
     def instructions(self) -> int:
@@ -212,6 +219,12 @@ class SpecEngine:
             if len(box) < cap:
                 box.append(msg)
                 delivered_any = True
+                if self.trace_msgs:
+                    self.msg_log.append(
+                        f"Processor {msg.sender} sent msg to: "
+                        f"{receiver}, type: {int(msg.type)}, "
+                        f"address: 0x{msg.address:02X}"
+                    )
                 if len(box) > self.max_mailbox_depth:
                     self.max_mailbox_depth = len(box)
             else:
@@ -648,6 +661,12 @@ class SpecEngine:
         for node in self.nodes:
             if node.mailbox and not node.pending_sends:
                 msg = node.mailbox.popleft()
+                if self.trace_msgs:
+                    self.msg_log.append(
+                        f"Processor {node.id} msg from: {msg.sender}, "
+                        f"type: {int(msg.type)}, "
+                        f"address: 0x{msg.address:02X}"
+                    )
                 self._handle(node, msg)
                 handled[node.id] = True
                 progress = True

@@ -37,11 +37,15 @@ class NativeError(RuntimeError):
 
 
 def ensure_built(force: bool = False) -> str:
-    """Build the native backend if needed; returns the library path."""
-    if force or not (os.path.exists(_LIB_PATH) and os.path.exists(_BIN_PATH)):
-        subprocess.run(
-            ["make", "-C", _NATIVE_DIR], check=True, capture_output=True
-        )
+    """Build the native backend; returns the library path.
+
+    make runs unconditionally (a no-op when timestamps are current):
+    an existing .so built from older sources would otherwise be
+    loaded across a C-ABI change and corrupt memory."""
+    del force  # retained for API compatibility; make decides
+    subprocess.run(
+        ["make", "-C", _NATIVE_DIR], check=True, capture_output=True
+    )
     return _LIB_PATH
 
 
@@ -58,7 +62,8 @@ def _load():
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
             ctypes.c_int, ctypes.c_ulonglong, ctypes.c_int,
-            ctypes.c_char_p, ctypes.POINTER(Hpa2Result),
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(Hpa2Result),
         ]
         lib.hpa2_bench_random.restype = ctypes.c_int
         lib.hpa2_bench_random.argtypes = [
@@ -89,6 +94,7 @@ def run_trace_dir(
     max_cycles: int = 100_000_000,
     threads: int = 0,
     record_order_path: Optional[str] = None,
+    msg_trace_path: Optional[str] = None,
 ) -> Hpa2Result:
     """Run the native engine on a trace directory.  Dump files are
     written to ``out_dir`` in the reference format.
@@ -96,7 +102,9 @@ def run_trace_dir(
     ``record_order_path`` writes the executed issue interleaving in
     DEBUG_INSTR format (assignment.c:596-597) — replayable on any
     lockstep engine (the record->replay->verify workflow that produced
-    the reference's multi-run fixtures, SURVEY.md §4)."""
+    the reference's multi-run fixtures, SURVEY.md §4).
+    ``msg_trace_path`` writes a per-message send/receive log in the
+    reference's DEBUG_MSG format (assignment.c:170-174, 734-738)."""
     _check_config(config)
     lib = _load()
     res = Hpa2Result()
@@ -108,6 +116,7 @@ def run_trace_dir(
         1 if config.semantics.intervention_miss_policy == "nack" else 0,
         (replay_path or "").encode(), int(candidates), int(final_dump),
         max_cycles, threads, (record_order_path or "").encode(),
+        (msg_trace_path or "").encode(),
         ctypes.byref(res),
     )
     if rc != 0 or not res.ok:

@@ -269,6 +269,31 @@ def gen_uniform_random_arrays(
     return op, addr, val, length
 
 
+def gen_producer_consumer_arrays(
+    config: SystemConfig,
+    batch: int,
+    instrs_per_core: int,
+    seed: int = 0,
+):
+    """Vectorized :func:`gen_producer_consumer` as ``[B, N, T]`` arrays
+    (BASELINE.json config 4 at scale: node n writes its own blocks and
+    reads node n+1's — the widened-bitVector sharing pattern)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n, t = config.num_procs, instrs_per_core
+    shape = (batch, n, t)
+    blk = rng.integers(0, config.mem_size, shape, dtype=np.int32)
+    val = rng.integers(0, 256, shape, dtype=np.int32)
+    node = np.arange(n, dtype=np.int32)[None, :, None]
+    write = (np.arange(t, dtype=np.int32)[None, None, :] % 2) == 0
+    op = np.broadcast_to(write, shape).astype(np.int32)
+    home = np.where(write, node, (node + 1) % n)
+    addr = home * config.mem_size + blk
+    length = np.full((batch, n), t, dtype=np.int32)
+    return op, addr, val, length
+
+
 def traces_to_arrays(config: SystemConfig, batch_traces):
     """[[Instr]] per system -> ([B,N,T] op/addr/val, [B,N] len) arrays
     (the input format of the batched/Pallas engines)."""

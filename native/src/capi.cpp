@@ -39,7 +39,7 @@ int hpa2_run_dir(const char* trace_dir, const char* out_dir, int mode,
                  int robust, const char* replay_path, int candidates,
                  int final_dump, unsigned long long max_cycles,
                  int threads, const char* record_order_path,
-                 Hpa2Result* result) {
+                 const char* msg_trace_path, Hpa2Result* result) {
   Config cfg;
   cfg.nodes = nodes;
   cfg.cache = cache;
@@ -58,11 +58,12 @@ int hpa2_run_dir(const char* trace_dir, const char* out_dir, int mode,
       mode = 0;
     }
     bool record = record_order_path && *record_order_path;
+    bool tmsg = msg_trace_path && *msg_trace_path;
     auto t0 = std::chrono::steady_clock::now();
     RunResult res = (mode == 1)
-                        ? run_omp(cfg, traces, threads, record)
+                        ? run_omp(cfg, traces, threads, record, tmsg)
                         : run_lockstep(cfg, traces, order_p, max_cycles,
-                                       candidates != 0);
+                                       candidates != 0, tmsg);
     auto t1 = std::chrono::steady_clock::now();
     result->seconds = std::chrono::duration<double>(t1 - t0).count();
     if (!res.error.empty()) {
@@ -72,6 +73,10 @@ int hpa2_run_dir(const char* trace_dir, const char* out_dir, int mode,
     if (record) {
       std::ofstream rf(record_order_path);
       rf << format_instruction_order(res.issue_order);
+    }
+    if (tmsg) {
+      std::ofstream mf(msg_trace_path);
+      for (const auto& line : res.msg_log) mf << line << "\n";
     }
     const auto& dumps = final_dump ? res.finals : res.snapshots;
     for (int n = 0; n < cfg.nodes; ++n) {

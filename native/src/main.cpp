@@ -42,7 +42,8 @@ static void write_file(const std::string& path, const std::string& text) {
 int main(int argc, char** argv) {
   Config cfg;
   std::string mode = "lockstep";
-  std::string trace_dir, replay_path, record_path, out_dir = ".";
+  std::string trace_dir, replay_path, record_path, msg_trace_path,
+      out_dir = ".";
   bool candidates = false, final_dump = false, json = false;
   int bench_instrs = 0, threads = 0;
   uint64_t seed = 0, max_cycles = 100'000'000ull;
@@ -65,6 +66,7 @@ int main(int argc, char** argv) {
     else if (a == "--robust") cfg.nack = true;
     else if (a == "--replay") replay_path = next();
     else if (a == "--record-order") record_path = next();
+    else if (a == "--trace-msgs") msg_trace_path = next();
     else if (a == "--candidates") candidates = true;
     else if (a == "--final") final_dump = true;
     else if (a == "--out") out_dir = next();
@@ -108,9 +110,11 @@ int main(int argc, char** argv) {
     auto t0 = std::chrono::steady_clock::now();
     RunResult res = (mode == "omp")
                         ? run_omp(cfg, traces, threads,
-                                  !record_path.empty())
+                                  !record_path.empty(),
+                                  !msg_trace_path.empty())
                         : run_lockstep(cfg, traces, order_p, max_cycles,
-                                       candidates);
+                                       candidates,
+                                       !msg_trace_path.empty());
     auto t1 = std::chrono::steady_clock::now();
     double secs = std::chrono::duration<double>(t1 - t0).count();
 
@@ -121,6 +125,11 @@ int main(int argc, char** argv) {
 
     if (!record_path.empty())
       write_file(record_path, format_instruction_order(res.issue_order));
+    if (!msg_trace_path.empty()) {
+      std::string log;
+      for (const auto& line : res.msg_log) log += line + "\n";
+      write_file(msg_trace_path, log);
+    }
 
     if (bench_instrs == 0) {
       const auto& dumps = final_dump ? res.finals : res.snapshots;

@@ -441,11 +441,28 @@ void issue_one(const Config& cfg, int self, NodeState& n, SendFn&& send) {
 // Deterministic lockstep engine (spec_engine.SpecEngine.step)
 // ---------------------------------------------------------------------
 
+static std::string fmt_msg_recv(int proc, const Msg& m) {
+  char buf[96];
+  std::snprintf(buf, sizeof buf,
+                "Processor %d msg from: %d, type: %d, address: 0x%02X",
+                proc, m.sender, (int)m.type, m.addr);
+  return buf;
+}
+
+static std::string fmt_msg_send(int recv, const Msg& m) {
+  char buf[96];
+  std::snprintf(buf, sizeof buf,
+                "Processor %d sent msg to: %d, type: %d, address: 0x%02X",
+                m.sender, recv, (int)m.type, m.addr);
+  return buf;
+}
+
 RunResult run_lockstep(const Config& cfg,
                        const std::vector<std::vector<Instr>>& traces,
                        const std::vector<IssueRecord>* replay,
                        uint64_t max_cycles,
-                       bool capture_candidates) {
+                       bool capture_candidates,
+                       bool trace_msgs) {
   RunResult res;
   const int N = cfg.nodes;
   std::vector<NodeState> nodes(N);
@@ -499,6 +516,7 @@ RunResult run_lockstep(const Config& cfg,
       if (mailbox[i].empty() || !pending[i].empty()) continue;
       Msg m = mailbox[i].front();
       mailbox[i].pop_front();
+      if (trace_msgs) res.msg_log.push_back(fmt_msg_recv(i, m));
       handle_msg(cfg, i, nodes[i], m, [&](int recv, const Msg& mm) {
         outbox.push_back(Cand{0, i, recv, mm});
       });
@@ -565,6 +583,8 @@ RunResult run_lockstep(const Config& cfg,
       for (auto& c : merged) {
         if ((int)mailbox[c.recv].size() < cfg.cap) {
           mailbox[c.recv].push_back(c.m);
+          if (trace_msgs)
+            res.msg_log.push_back(fmt_msg_send(c.recv, c.m));
           res.counters.messages++;
           progress = true;
         } else {
@@ -624,7 +644,7 @@ struct RingBox {
 
 RunResult run_omp(const Config& cfg,
                   const std::vector<std::vector<Instr>>& traces,
-                  int num_threads, bool record_order) {
+                  int num_threads, bool record_order, bool trace_msgs) {
   RunResult res;
   const int N = cfg.nodes;
   if (num_threads <= 0) num_threads = N;
@@ -651,6 +671,14 @@ RunResult run_omp(const Config& cfg,
     for (auto& t : traces) total_instrs += t.size();
   std::vector<IssueRecord> order_buf(total_instrs);
   std::atomic<uint64_t> issue_seq{0};
+  omp_lock_t log_lock;
+  omp_init_lock(&log_lock);
+  auto log_line = [&](std::string s) {
+    if (!trace_msgs) return;
+    omp_set_lock(&log_lock);
+    res.msg_log.push_back(std::move(s));
+    omp_unset_lock(&log_lock);
+  };
   std::atomic<bool> aborted{false};  // livelock watchdog (the
   // reference spins forever on this class; SURVEY.md §6.3).
   // Wall-clock deadline, not a yield count: sched_yield() latency
@@ -681,6 +709,9 @@ RunResult run_omp(const Config& cfg,
     box[recv].ring[box[recv].tail] = m;
     box[recv].tail = (box[recv].tail + 1) % cfg.cap;
     box[recv].count++;
+    // log before releasing the box lock: the receiver cannot dequeue
+    // until then, so every message's send line precedes its receive
+    if (trace_msgs) log_line(fmt_msg_send(recv, m));
     omp_unset_lock(&box[recv].lock);
   };
 
@@ -723,6 +754,7 @@ RunResult run_omp(const Config& cfg,
           box[i].head = (box[i].head + 1) % cfg.cap;
           box[i].count--;
           omp_unset_lock(&box[i].lock);
+          if (trace_msgs) log_line(fmt_msg_recv(i, m));
           handle_msg(cfg, i, nd, m, csend);
           inflight.fetch_sub(1, std::memory_order_release);
           progressed = true;
@@ -776,6 +808,7 @@ RunResult run_omp(const Config& cfg,
   }
 
   for (int i = 0; i < N; ++i) omp_destroy_lock(&box[i].lock);
+  omp_destroy_lock(&log_lock);
   if (record_order)
     res.issue_order.assign(order_buf.begin(),
                            order_buf.begin() + issue_seq.load());

@@ -143,6 +143,10 @@ struct RunResult {
   // run-sets (the reference's record->replay->verify workflow,
   // SURVEY.md §4)
   std::vector<IssueRecord> issue_order;
+  // per-message send/receive log in the reference's DEBUG_MSG format
+  // (assignment.c:170-174 receive, 734-738 send); filled when
+  // trace_msgs is set
+  std::vector<std::string> msg_log;
   Counters counters;
   bool completed = false;   // reached quiescence
   std::string error;
@@ -152,14 +156,16 @@ RunResult run_lockstep(const Config& cfg,
                        const std::vector<std::vector<Instr>>& traces,
                        const std::vector<IssueRecord>* replay,
                        uint64_t max_cycles,
-                       bool capture_candidates);
+                       bool capture_candidates,
+                       bool trace_msgs = false);
 
 RunResult run_omp(const Config& cfg,
                   const std::vector<std::vector<Instr>>& traces,
                   int num_threads /* 0 = one per node */,
                   bool record_order = false /* fill issue_order; off by
                   default: the per-issue atomic would contend in the
-                  benchmark hot loop */);
+                  benchmark hot loop */,
+                  bool trace_msgs = false);
 
 // synthetic workloads for benchmarking (LCG-based, deterministic)
 std::vector<std::vector<Instr>> gen_uniform_random(const Config& cfg,

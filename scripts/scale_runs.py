@@ -1,0 +1,92 @@
+"""BASELINE.json configs 4-5 at full size on the real TPU.
+
+  config4: 256-node x ~1M-instr producer-consumer trace (8 sharer
+           words — the scaling analog of the reference's 1-byte
+           bitVector cap, assignment.c:49) on the XLA engine.
+  config5: 1024-system ensemble x 10K instrs/core uniform-random on
+           the Pallas engine (windowed traces).
+
+Prints one JSON line per config for PERF.md.
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def config4(instrs_per_core=4096):
+    import jax
+    import jax.numpy as jnp
+
+    from hpa2_tpu.config import Semantics, SystemConfig
+    from hpa2_tpu.ops.engine import build_batched_run
+    from hpa2_tpu.ops.state import init_state_batched
+    from hpa2_tpu.ops.step import quiescent
+    from hpa2_tpu.utils.trace import gen_producer_consumer_arrays
+
+    config = SystemConfig(
+        num_procs=256, msg_buffer_size=64,
+        max_instr_num=0, semantics=Semantics().robust(),
+    )
+    arrays = gen_producer_consumer_arrays(config, 1, instrs_per_core)
+    state = init_state_batched(config, *arrays)
+    run = build_batched_run(config, max_cycles=2_000_000)
+    out = jax.block_until_ready(run(state))  # compile+run once
+    state = init_state_batched(config, *arrays)
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(run(state))
+    dt = time.perf_counter() - t0
+    assert bool(jnp.all(jax.vmap(quiescent)(out))), "no quiescence"
+    assert not bool(jnp.any(out.overflow))
+    instrs = int(jnp.sum(out.n_instr))
+    cycles = int(jnp.max(out.cycle))
+    print(json.dumps({
+        "config": "4: 256-node x 1M producer-consumer (xla)",
+        "nodes": 256, "sharer_words": config.sharer_words,
+        "instructions": instrs, "cycles": cycles,
+        "seconds": round(dt, 2),
+        "ops_per_sec": round(instrs / dt, 1),
+    }), flush=True)
+
+
+def config5(batch=1024, instrs_per_core=10_000):
+    import numpy as np
+
+    from hpa2_tpu.config import Semantics, SystemConfig
+    from hpa2_tpu.ops.pallas_engine import PallasEngine, _SC_CYCLE
+    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+    config = SystemConfig(
+        num_procs=8, msg_buffer_size=16, max_instr_num=0,
+        semantics=Semantics().robust(),
+    )
+    arrays = gen_uniform_random_arrays(config, batch, instrs_per_core)
+
+    def build():
+        return PallasEngine(config, *arrays, block=512,
+                            cycles_per_call=128, snapshots=False,
+                            trace_window=32)
+
+    build().run(max_cycles=5_000_000)  # compile + warm
+    eng = build()
+    t0 = time.perf_counter()
+    eng.run(max_cycles=5_000_000)
+    dt = time.perf_counter() - t0
+    cycles = int(np.max(np.asarray(eng.state["scalars"][_SC_CYCLE])))
+    print(json.dumps({
+        "config": "5: 1024-system x 10K-instr ensemble (pallas)",
+        "nodes": 8, "batch": batch,
+        "instructions": eng.instructions, "cycles": cycles,
+        "seconds": round(dt, 2),
+        "ops_per_sec": round(eng.instructions / dt, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    if which in ("4", "both"):
+        config4()
+    if which in ("5", "both"):
+        config5()
