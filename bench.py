@@ -4,18 +4,22 @@ assignment.c:135-137, rebuilt in native/).
 
 Workload (BASELINE.json configs 3+5): an ensemble of independent
 8-node systems, uniform-random RD/WR traces, run to quiescence on one
-chip.  Primary engine: the VMEM-resident Pallas kernel
-(ops/pallas_engine.py); falls back to the XLA ``lax.while_loop``
-engine if the kernel path fails.  Baseline: the C++/OpenMP engine on
-the same uniform-random workload shape (both sides report a rate, so
-instruction volumes need not match).
+chip.  Primary engine: the VMEM-resident Mosaic Pallas kernel
+(ops/pallas_engine.py) driven by its on-device run loop; falls back to
+the XLA ``lax.while_loop`` engine if the kernel path fails — and says
+so in the JSON (``engine`` + ``pallas_error``).  Baseline: the
+C++/OpenMP engine on the same uniform-random workload shape (both
+sides report a rate, so instruction volumes need not match).
 
 ALWAYS prints exactly ONE JSON line on stdout.  The axon TPU tunnel
-can hang or refuse backend init (round-1 artifact: rc=1, no JSON), so
-the parent process never touches JAX itself: it probes the TPU in a
-timeout-guarded subprocess (one retry), runs the measurement in a
-second subprocess (TPU env or forced-CPU fallback env), and if every
-child fails it still emits a JSON line with a ``note``.
+can hang or refuse backend init (round-1 artifact: rc=1, no JSON; the
+round-4 tunnel also wedged mid-session), so the parent process never
+touches JAX itself: it probes the TPU in a timeout-guarded subprocess
+(one retry), PROBE-COMPILES the Pallas kernel in a second subprocess
+(the cheap Mosaic smoke gate the round-3 verdict asked for — a
+regression fails loudly here, not 540s into a bench), runs the
+measurement in a third, and if every child fails it still emits a
+JSON line with a ``note``.
 """
 
 from __future__ import annotations
@@ -28,13 +32,51 @@ import time
 
 _REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 _PROBE_TIMEOUT_S = 90
+_COMPILE_GATE_TIMEOUT_S = 240
 _TPU_CHILD_TIMEOUT_S = 540
 _CPU_CHILD_TIMEOUT_S = 300
 
+# bench workload shape (see child_main)
+_TPU_BATCH, _TPU_INSTRS = 32768, 128
+_BLOCK, _CAP, _WINDOW, _K = 512, 16, 32, 128
+
+
+def _bench_config():
+    from hpa2_tpu.config import Semantics, SystemConfig
+
+    return SystemConfig(
+        num_procs=8, msg_buffer_size=_CAP,
+        semantics=Semantics().robust(),
+    )
+
 
 # ---------------------------------------------------------------------------
-# child: the actual measurement (runs under a known-good platform env)
+# children (each runs in its own interpreter under a known-good env)
 # ---------------------------------------------------------------------------
+
+def compile_gate_main() -> int:
+    """Compile-only AOT lowering of the Pallas kernel (no execution):
+    catches Mosaic regressions in seconds.  Prints one JSON line."""
+    import jax
+
+    from hpa2_tpu.ops.pallas_engine import PallasEngine
+    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+    config = _bench_config()
+    arrays = gen_uniform_random_arrays(config, 1024, 16, seed=0)
+    t0 = time.time()
+    try:
+        eng = PallasEngine(config, *arrays, block=_BLOCK,
+                           cycles_per_call=8, interpret=False,
+                           snapshots=False)
+        eng._call.lower(eng.state, eng.traces).compile()
+    except Exception as e:  # noqa: BLE001 - reported upward as data
+        print(json.dumps({"ok": False, "error": str(e)[-400:]}))
+        return 1
+    print(json.dumps({"ok": True, "compile_s": round(time.time() - t0, 1),
+                      "platform": jax.devices()[0].platform}))
+    return 0
+
 
 def bench_pallas(config, batch, instrs_per_core, seed=0):
     from hpa2_tpu.ops.pallas_engine import PallasEngine
@@ -42,8 +84,14 @@ def bench_pallas(config, batch, instrs_per_core, seed=0):
 
     arrays = gen_uniform_random_arrays(config, batch, instrs_per_core,
                                        seed=seed)
-    PallasEngine(config, *arrays).run()  # compile + warmup
-    eng = PallasEngine(config, *arrays)
+
+    def build():
+        return PallasEngine(config, *arrays, block=_BLOCK,
+                            cycles_per_call=_K, snapshots=False,
+                            trace_window=_WINDOW)
+
+    build().run()  # compile + warmup
+    eng = build()
     t0 = time.perf_counter()
     eng.run()
     dt = time.perf_counter() - t0
@@ -91,23 +139,25 @@ def bench_omp(config, instrs_per_core, seed=0):
     return int(res.instructions), float(res.seconds)
 
 
-def child_main(platform: str) -> int:
-    from hpa2_tpu.config import Semantics, SystemConfig
-
-    config = SystemConfig(
-        num_procs=8, msg_buffer_size=32, semantics=Semantics().robust()
-    )
+def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
+    config = _bench_config()
     on_tpu = platform == "tpu"
     if on_tpu:
-        batch, instrs_per_core = 8192, 128  # 8.4M instrs
+        batch, instrs_per_core = _TPU_BATCH, _TPU_INSTRS  # 33.5M instrs
     else:  # CPU smoke (pallas runs interpreted): keep it tiny
         batch, instrs_per_core = 8, 16
 
     engine = "pallas"
-    try:
-        jax_instrs, jax_dt = bench_pallas(config, batch, instrs_per_core)
-    except Exception as e:
-        print(f"pallas path failed ({e}); falling back to XLA engine",
+    err = pallas_error
+    if pallas_ok or not on_tpu:
+        try:
+            jax_instrs, jax_dt = bench_pallas(config, batch,
+                                              instrs_per_core)
+        except Exception as e:  # noqa: BLE001
+            err = str(e)[-300:]
+            pallas_ok = False
+    if not (pallas_ok or not on_tpu):
+        print(f"pallas path failed ({err}); falling back to XLA engine",
               file=sys.stderr)
         engine = "xla"
         if on_tpu:
@@ -122,9 +172,12 @@ def child_main(platform: str) -> int:
         "vs_baseline": None,
         "engine": engine,
         "platform": platform,
+        "batch": batch,
         "jax_instrs": jax_instrs,
         "jax_seconds": round(jax_dt, 4),
     }
+    if engine != "pallas":
+        result["pallas_error"] = err
     try:
         omp_instrs, omp_dt = bench_omp(config, instrs_per_core=50_000)
         omp_ops = omp_instrs / omp_dt
@@ -187,7 +240,32 @@ def _probe_tpu() -> bool:
     return False
 
 
-def _run_child(platform: str, timeout_s: int):
+def _compile_gate():
+    """Run the Mosaic compile smoke child; returns (ok, error_str)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--compile-gate"],
+            env=_hostenv().cache_env(dict(os.environ)),
+            cwd=_REPO_ROOT,
+            timeout=_COMPILE_GATE_TIMEOUT_S,
+            capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"compile gate timeout ({_COMPILE_GATE_TIMEOUT_S}s)"
+    sys.stderr.write(proc.stderr.decode(errors="replace")[-2000:])
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            return bool(rec.get("ok")), rec.get("error", "")
+    return False, f"compile gate rc={proc.returncode}, no JSON"
+
+
+def _run_child(platform: str, timeout_s: int, pallas_ok: bool,
+               pallas_error: str):
     """Run the measurement child; returns the parsed JSON dict or None."""
     try:
         hostenv = _hostenv()
@@ -197,7 +275,8 @@ def _run_child(platform: str, timeout_s: int):
             else hostenv.forced_cpu_env()
         )
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child", platform],
+            [sys.executable, os.path.abspath(__file__), "--child", platform,
+             "1" if pallas_ok else "0", pallas_error],
             env=env,
             cwd=_REPO_ROOT,
             timeout=timeout_s,
@@ -221,15 +300,28 @@ def _run_child(platform: str, timeout_s: int):
 
 
 def main() -> int:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--compile-gate":
+        return compile_gate_main()
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
-        return child_main(sys.argv[2])
+        return child_main(
+            sys.argv[2],
+            len(sys.argv) < 4 or sys.argv[3] == "1",
+            sys.argv[4] if len(sys.argv) > 4 else "",
+        )
 
     tpu_ok = _probe_tpu()
     result = None
     if tpu_ok:
-        result = _run_child("tpu", _TPU_CHILD_TIMEOUT_S)
+        pallas_ok, pallas_err = _compile_gate()
+        if not pallas_ok:
+            print(f"pallas compile gate FAILED: {pallas_err}",
+                  file=sys.stderr)
+        result = _run_child("tpu", _TPU_CHILD_TIMEOUT_S, pallas_ok,
+                            pallas_err)
+        if result is not None and not pallas_ok:
+            result["pallas_error"] = pallas_err
     if result is None:
-        result = _run_child("cpu", _CPU_CHILD_TIMEOUT_S)
+        result = _run_child("cpu", _CPU_CHILD_TIMEOUT_S, True, "")
         if result is not None:
             why = (
                 "tpu measurement child failed"

@@ -131,7 +131,13 @@ def _bits_for(n_values: int) -> int:
 @functools.lru_cache(maxsize=64)
 def _mb_layout(config: SystemConfig):
     """Field -> (word, offset, width) packing for one message, plus the
-    word count W.  Words hold at most 31 bits (sign-safe shifts)."""
+    word count W.  Words hold at most 31 bits (sign-safe shifts).
+
+    A trailing "recv" field (stored recv+1; only meaningful in
+    DEFERRED outbox words) is added when it fits the last word for
+    free — it then replaces the separate ob_recv plane in VMEM.  The
+    reference geometry packs type4+sender3+second4+addr7+aux9+recv4 =
+    31 bits exactly.  Wire (mailbox) words leave those bits zero."""
     n = config.num_procs
     fields = (
         ("type", 4),
@@ -147,6 +153,9 @@ def _mb_layout(config: SystemConfig):
             word, off = word + 1, 0
         layout[name] = (word, off, wd)
         off += wd
+    recv_wd = _bits_for(n + 1)          # stored as recv+1
+    if off + recv_wd <= 31:
+        layout["recv"] = (word, off, recv_wd)
     return layout, word + 1
 
 
@@ -161,12 +170,12 @@ def _check_geometry(config: SystemConfig) -> None:
 
 
 #: per-engine carried state names, in kernel argument order
-def _state_fields(W: int, snapshots: bool):
+def _state_fields(W: int, snapshots: bool, recv_packed: bool):
     f = ["cachew", "dirw"]
     f += [f"mb{w}" for w in range(W)]
     f += ["mb_count", "pc", "waiting", "pending_write"]
     f += [f"ob{w}" for w in range(W)]
-    f += ["ob_recv", "ob_valid"]
+    f += ([] if recv_packed else ["ob_recv"]) + ["ob_valid"]
     if snapshots:
         f += ["snap_taken", "snap_cachew", "snap_dirw"]
     f += ["scalars", "msg_counts"]
@@ -222,6 +231,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         raise ValueError("pallas engine implements fixture semantics only")
     nack = sem.intervention_miss_policy == "nack"
     layout, W = _mb_layout(config)
+    recv_packed = "recv" in layout
     sh_mask = (1 << n) - 1
     addr_mask = (1 << 21) - 1
 
@@ -242,8 +252,8 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         for w in range(W):
             acc = None
             for name, (ww, off, wd) in layout.items():
-                if ww != w:
-                    continue
+                if ww != w or name == "recv":
+                    continue  # recv rides only DEFERRED (outbox) words
                 x = vals[name]
                 if off:
                     x = x << off
@@ -641,7 +651,11 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             pv = obv[:, k, :] != 0
             words = [s[f"ob{w}"][:, k, :] for w in range(W)]
             sl["valid"] = sl["valid"] | pv
-            sl["recv"] = jnp.where(pv, s["ob_recv"][:, k, :], sl["recv"])
+            old_recv = (
+                dec(words, "recv") - 1 if recv_packed
+                else s["ob_recv"][:, k, :]
+            )
+            sl["recv"] = jnp.where(pv, old_recv, sl["recv"])
             sl["type"] = jnp.where(pv, dec(words, "type"), sl["type"])
             sl["addr"] = jnp.where(pv, dec(words, "addr"), sl["addr"])
             sl["aux"] = jnp.where(pv, dec(words, "aux"), sl["aux"])
@@ -764,15 +778,22 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             [rej[0], rej[1], (remaining != 0).astype(I32),
              rej[2], rej[3]], axis=1,
         )                                      # [N, 5, B]
-        ob_recv_new = jnp.stack(
-            [sA0["recv"], sA1["recv"], neg1_nb,
-             sB0["recv"], sB1["recv"]], axis=1,
-        )
+        recvs5 = (sA0["recv"], sA1["recv"], neg1_nb,
+                  sB0["recv"], sB1["recv"])
+        if not recv_packed:
+            ob_recv_new = jnp.stack(recvs5, axis=1)
         ob_new = []
+        if recv_packed:
+            recv_w, recv_off, _ = layout["recv"]
         for w in range(W):
             ws = [words5[k][w] for k in range(_NSLOTS)]
             if w == aux_w:
                 ws[2] = ws[2] | (remaining << aux_off)
+            if recv_packed and w == recv_w:
+                ws = [
+                    wk | ((recvs5[k] + 1) << recv_off)
+                    for k, wk in enumerate(ws)
+                ]
             ob_new.append(jnp.stack(ws, axis=1))
         if "deliver" in ablate:
             # timing fiction, matching the pre-hoist ablation: sends
@@ -795,9 +816,11 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             "mb_count": mb_count3, "pc": pc,
             "waiting": waiting,
             "pending_write": pending_write,
-            "ob_recv": ob_recv_new, "ob_valid": ob_valid_new,
+            "ob_valid": ob_valid_new,
             "tr": s["tr"], "tr_len": s["tr_len"],
         }
+        if not recv_packed:
+            out["ob_recv"] = ob_recv_new
         for w in range(W):
             out[f"mb{w}"] = mbs[w]
             out[f"ob{w}"] = ob_new[w]
@@ -889,7 +912,7 @@ def _init_state(config: SystemConfig, b: int, snapshots: bool = True):
     (initializeProcessor semantics, assignment.c:776-822)."""
     n, c, m = config.num_procs, config.cache_size, config.mem_size
     cap = config.msg_buffer_size
-    _, W = _mb_layout(config)
+    layout, W = _mb_layout(config)
     _check_geometry(config)
 
     mem0 = np.array(
@@ -907,7 +930,6 @@ def _init_state(config: SystemConfig, b: int, snapshots: bool = True):
         "dirw": dirw0,
         "mb_count": z2.copy(), "pc": z2.copy(),
         "waiting": z2.copy(), "pending_write": z2.copy(),
-        "ob_recv": np.zeros((n, _NSLOTS, b), np.int32),
         "ob_valid": np.zeros((n, _NSLOTS, b), np.int32),
         "scalars": np.zeros((_NSCALAR, b), np.int32),
         "msg_counts": np.zeros((_NTYPES, b), np.int32),
@@ -915,6 +937,8 @@ def _init_state(config: SystemConfig, b: int, snapshots: bool = True):
     for w in range(W):
         state[f"mb{w}"] = np.zeros((n, cap, b), np.int32)
         state[f"ob{w}"] = np.zeros((n, _NSLOTS, b), np.int32)
+    if "recv" not in layout:
+        state["ob_recv"] = np.zeros((n, _NSLOTS, b), np.int32)
     if snapshots:
         state.update({
             "snap_taken": z2.copy(),
@@ -939,8 +963,8 @@ def _build_call(config: SystemConfig, b: int, bb: int, k: int,
     cycle = build_cycle(config, bb, snapshots, ablate)
     n, c, m = config.num_procs, config.cache_size, config.mem_size
     cap, nt = config.msg_buffer_size, _NTYPES
-    _, W = _mb_layout(config)
-    fields = _state_fields(W, snapshots)
+    layout, W = _mb_layout(config)
+    fields = _state_fields(W, snapshots, "recv" in layout)
     outer, inner = -(-k // _GATE), _GATE
 
     shapes = {

@@ -49,3 +49,11 @@ def reference_tests_dir():
     if not REFERENCE_TESTS.is_dir():
         pytest.skip("reference test corpus not available")
     return REFERENCE_TESTS
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "sweep: randomized cross-engine differential sweep "
+        "(tests/test_random_differential.py)",
+    )
