@@ -31,10 +31,16 @@ import sys
 import time
 
 _REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
-_PROBE_TIMEOUT_S = 90
+# escalating probe budget: the axon tunnel's cold start has been seen
+# to need minutes (the dryrun budget is 480s); 90s x2 was too brittle
+# (BENCH_r04: both probes timed out while the same-day dryrun passed)
+_PROBE_TIMEOUTS_S = (90, 180, 480)
 _COMPILE_GATE_TIMEOUT_S = 240
 _TPU_CHILD_TIMEOUT_S = 540
 _CPU_CHILD_TIMEOUT_S = 300
+# every successful TPU measurement is persisted here so a tunnel-down
+# round still reports the last real TPU number (marked stale)
+_LAST_TPU_PATH = os.path.join(_REPO_ROOT, "BENCH_LAST_TPU.json")
 
 # bench workload shape (see child_main)
 _TPU_BATCH, _TPU_INSTRS = 32768, 128
@@ -149,14 +155,15 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
 
     engine = "pallas"
     err = pallas_error
-    if pallas_ok or not on_tpu:
+    ran_ok = False
+    if pallas_ok or not on_tpu:  # CPU always tries interpret mode
         try:
             jax_instrs, jax_dt = bench_pallas(config, batch,
                                               instrs_per_core)
+            ran_ok = True
         except Exception as e:  # noqa: BLE001
             err = str(e)[-300:]
-            pallas_ok = False
-    if not (pallas_ok or not on_tpu):
+    if not ran_ok:
         print(f"pallas path failed ({err}); falling back to XLA engine",
               file=sys.stderr)
         engine = "xla"
@@ -207,19 +214,20 @@ def _hostenv():
 
 def _probe_tpu() -> bool:
     """True iff a fresh interpreter sees a TPU within the timeout.
-    One retry on timeout/crash only — rc=3 ("no TPU present") is a
-    deterministic answer, not tunnel flakiness."""
+    Retries escalate the budget (tunnel cold starts have needed
+    minutes); rc=3 ("no TPU present") is a deterministic answer, not
+    tunnel flakiness, and stops the retries."""
     code = (
         "import sys, jax; ds = jax.devices(); "
         "sys.exit(0 if any('tpu' in str(d).lower() for d in ds) else 3)"
     )
-    for attempt in range(2):
+    for attempt, budget in enumerate(_PROBE_TIMEOUTS_S):
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", code],
                 env=_hostenv().cache_env(dict(os.environ)),
                 cwd=_REPO_ROOT,
-                timeout=_PROBE_TIMEOUT_S,
+                timeout=budget,
                 capture_output=True,
             )
             if proc.returncode == 0:
@@ -233,11 +241,44 @@ def _probe_tpu() -> bool:
                 return False
         except subprocess.TimeoutExpired:
             print(
-                f"tpu probe attempt {attempt + 1}: timeout "
-                f"({_PROBE_TIMEOUT_S}s)",
+                f"tpu probe attempt {attempt + 1}: timeout ({budget}s)",
                 file=sys.stderr,
             )
     return False
+
+
+def _record_last_tpu(result: dict) -> None:
+    """Persist a successful TPU measurement (committed to the repo so
+    a tunnel-down round still carries the last real number).  An
+    XLA-fallback run never overwrites a pallas record — the fallback
+    is ~an order of magnitude slower, and replacing the real number
+    with it would make the next tunnel-down round read as a perf
+    regression."""
+    try:
+        prev = _load_last_tpu()
+        if (
+            prev is not None
+            and prev.get("engine") == "pallas"
+            and result.get("engine") != "pallas"
+        ):
+            return
+        rec = dict(result)
+        rec["recorded_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime())
+        with open(_LAST_TPU_PATH, "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    except OSError as e:
+        print(f"could not persist last-good TPU result: {e}",
+              file=sys.stderr)
+
+
+def _load_last_tpu():
+    try:
+        with open(_LAST_TPU_PATH) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
 
 
 def _compile_gate():
@@ -320,6 +361,8 @@ def main() -> int:
                             pallas_err)
         if result is not None and not pallas_ok:
             result["pallas_error"] = pallas_err
+        if result is not None and result.get("platform") == "tpu":
+            _record_last_tpu(result)
     if result is None:
         result = _run_child("cpu", _CPU_CHILD_TIMEOUT_S, True, "")
         if result is not None:
@@ -331,6 +374,20 @@ def main() -> int:
             result["note"] = (
                 result.get("note", "") + f" {why}; cpu smoke result"
             ).strip()
+            last = _load_last_tpu()
+            if last is not None:
+                # carry the last real TPU measurement, clearly dated
+                # and marked stale, so a tunnel-down round is not
+                # mistaken for a perf regression
+                result["last_good_tpu"] = {
+                    "stale": True, **{
+                        k: last[k]
+                        for k in ("value", "vs_baseline", "engine",
+                                  "batch", "jax_instrs", "jax_seconds",
+                                  "recorded_at")
+                        if k in last
+                    },
+                }
     if result is None:  # every path failed: still emit the JSON line
         result = {
             "metric": "sim_ops_per_sec_jax",

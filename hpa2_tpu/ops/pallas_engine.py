@@ -280,12 +280,17 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
                 jnp.where(iota_m == idx[:, None, :], arr, 0), axis=1
             )
 
+        # masked one-hot writes fold the mask into the index compare
+        # (idx is always >= 0): one 3D compare, and no 2D->3D broadcast
+        # of a bool vector — those round-trip through i8 inside Mosaic
+        # and newer libtpu rejects the i8->i1 trunci (BENCH_r04 driver
+        # AOT failure)
         def write_c(arr, idx, mask, val):
-            hot = (iota_c == idx[:, None, :]) & mask[:, None, :]
+            hot = iota_c == jnp.where(mask, idx, -1)[:, None, :]
             return jnp.where(hot, val[:, None, :], arr)
 
         def write_m(arr, idx, mask, val):
-            hot = (iota_m == idx[:, None, :]) & mask[:, None, :]
+            hot = iota_m == jnp.where(mask, idx, -1)[:, None, :]
             return jnp.where(hot, val[:, None, :], arr)
         # nodes with deferred sends are blocked (no handle, no issue)
         blocked = jnp.sum(s["ob_valid"], axis=1) > 0        # [N, B]
@@ -302,14 +307,15 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         aux = dec(heads, "aux")
         v = aux & 0xFF
 
+        has_msg_i = has_msg.astype(I32)
         qdata = []
         for w in range(W):
             rolled = jnp.concatenate(
                 [s[f"mb{w}"][:, 1:, :], s[f"mb{w}"][:, :1, :]], axis=1
             )
-            qdata.append(jnp.where(has_msg[:, None, :], rolled,
+            qdata.append(jnp.where(has_msg_i[:, None, :] != 0, rolled,
                                    s[f"mb{w}"]))
-        count2 = s["mb_count"] - has_msg.astype(I32)
+        count2 = s["mb_count"] - has_msg_i
 
         home = a // m
         blk = a % m
@@ -337,13 +343,16 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         false = jnp.zeros((n, bb), dtype=bool)
 
         def slot():
+            # valid is i32 0/1, not bool: slot rows are indexed,
+            # broadcast, and stacked below, all Mosaic i8<->i1 hazards
+            # for bool vectors
             return {
-                "valid": false, "recv": zero, "type": zero, "addr": zero,
+                "valid": zero, "recv": zero, "type": zero, "addr": zero,
                 "aux": zero, "second": jnp.full((n, bb), -1, I32),
             }
 
         def put(sl, mask, recv, type_, addr, aux=None, second=None):
-            sl["valid"] = sl["valid"] | mask
+            sl["valid"] = jnp.where(mask, 1, sl["valid"])
             sl["recv"] = jnp.where(mask, recv, sl["recv"])
             sl["type"] = jnp.where(mask, type_, sl["type"])
             sl["addr"] = jnp.where(mask, addr, sl["addr"])
@@ -650,7 +659,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         def merge_slot(sl, k):
             pv = obv[:, k, :] != 0
             words = [s[f"ob{w}"][:, k, :] for w in range(W)]
-            sl["valid"] = sl["valid"] | pv
+            sl["valid"] = jnp.where(pv, 1, sl["valid"])
             old_recv = (
                 dec(words, "recv") - 1 if recv_packed
                 else s["ob_recv"][:, k, :]
@@ -708,19 +717,29 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         def candidate(mbs, acc, k, sender, valid_nb):
             pos = count2 + acc
             accepted = valid_nb & (pos < cap)
-            hot = (iota_cap == pos[:, None, :]) & accepted[:, None, :]
+            acc_i = accepted.astype(I32)
+            # mask folded into the position compare (pos >= 0 always):
+            # no bool-vector broadcast (Mosaic i8->i1 hazard)
+            hot = iota_cap == jnp.where(accepted, pos, -1)[:, None, :]
             mbs = [
                 jnp.where(hot, words5[k][w][sender][None, None, :],
                           mbs[w])
                 for w in range(W)
             ]
-            acc_masks[k][sender] = accepted
-            return mbs, acc + accepted.astype(I32)
+            acc_masks[k][sender] = acc_i
+            return mbs, acc + acc_i
 
-        def point_valid(sl, sender):
-            return sl["valid"][sender][None, :] & (
-                iota_n == sl["recv"][sender][None, :]
-            )
+        # per-slot receiver map: -1 where the slot is empty, so the
+        # per-sender validity check is ONE i32 row broadcast + compare
+        # (bool rows can't be indexed/broadcast Mosaic-safely)
+        def tgt_of(sl):
+            return jnp.where(sl["valid"] != 0, sl["recv"], -1)
+
+        tgtA0, tgtA1 = tgt_of(sA0), tgt_of(sA1)
+        tgtB0, tgtB1 = tgt_of(sB0), tgt_of(sB1)
+
+        def point_valid(tgt, sender):
+            return iota_n == tgt[sender][None, :]
 
         def inv_valid(sender):
             return ((inv_sharers[sender][None, :] >> iota_n) & 1) == 1
@@ -728,26 +747,28 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         if "deliver" in ablate:
             for k_ in range(_NSLOTS):
                 for sender in range(n):
-                    acc_masks[k_][sender] = false
+                    acc_masks[k_][sender] = zero
         else:
             for sender in range(n):
                 mbs, acc = candidate(mbs, acc, 0, sender,
-                                     point_valid(sA0, sender))
+                                     point_valid(tgtA0, sender))
                 mbs, acc = candidate(mbs, acc, 1, sender,
-                                     point_valid(sA1, sender))
+                                     point_valid(tgtA1, sender))
                 mbs, acc = candidate(mbs, acc, 2, sender,
                                      inv_valid(sender))
             for sender in range(n):
                 mbs, acc = candidate(mbs, acc, 3, sender,
-                                     point_valid(sB0, sender))
+                                     point_valid(tgtB0, sender))
                 mbs, acc = candidate(mbs, acc, 4, sender,
-                                     point_valid(sB1, sender))
+                                     point_valid(tgtB1, sender))
 
-        # post-loop bookkeeping on stacked masks (sums are order-free)
+        # post-loop bookkeeping on stacked masks (sums are order-free;
+        # masks are already i32 — stacking bool vectors is a Mosaic
+        # i8->i1 hazard)
         accs = jnp.stack(
             [jnp.stack(acc_masks[k], axis=0) for k in range(_NSLOTS)],
             axis=1,
-        ).astype(I32)                          # [S(sender), 5, R(recv), B]
+        )                                      # [S(sender), 5, R(recv), B]
         dcount = jnp.sum(accs, axis=2)         # [S, 5, B] per candidate
         md = jnp.sum(dcount, axis=(0, 1))[None, :]          # [1, B]
         type_arr = jnp.stack(
@@ -770,8 +791,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         inv_acc_bits = jnp.sum(accs[:, 2, :, :] << io_r, axis=1)
         remaining = inv_sharers & ~inv_acc_bits
         rej = [
-            slots5[k]["valid"].astype(I32)
-            * (dcount[:, k, :] == 0).astype(I32)
+            jnp.where(dcount[:, k, :] == 0, slots5[k]["valid"], 0)
             for k in (0, 1, 3, 4)
         ]
         ob_valid_new = jnp.stack(
@@ -832,7 +852,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
                 & ~blocked_next
             )
             snap_now = done_node & ~(s["snap_taken"] != 0)
-            s2 = snap_now[:, None, :]
+            s2 = snap_now.astype(I32)[:, None, :] != 0
             out["snap_taken"] = (
                 (s["snap_taken"] != 0) | done_node
             ).astype(I32)
@@ -842,8 +862,20 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         # ===== counters ==============================================
         row = lambda x: jnp.sum(x.astype(I32), axis=0, keepdims=True)
         sc = s["scalars"]
+        # a lane only accrues a cycle while it has outstanding work at
+        # cycle start — the quiescence gate runs every _GATE cycles (or
+        # never, gate=False), so an unconditional increment would
+        # overshoot quiescence by up to the gate window and diverge
+        # from the spec/native cycle counters
+        lane_active = (
+            jnp.sum(jnp.maximum(s["tr_len"] - s["pc"], 0), axis=0,
+                    keepdims=True)
+            + jnp.sum(s["waiting"], axis=0, keepdims=True)
+            + jnp.sum(s["mb_count"], axis=0, keepdims=True)
+            + jnp.sum(s["ob_valid"], axis=(0, 1))[None, :]
+        )
         upd = [
-            (_SC_CYCLE, jnp.ones((1, bb), I32)),
+            (_SC_CYCLE, jnp.minimum(lane_active, 1)),
             (_SC_INSTR, row(elig)),
             (_SC_MSGS, md),
             (_SC_OVERFLOW, ov_inc),
@@ -1083,7 +1115,7 @@ def _build_run(config: SystemConfig, b: int, bb: int, k: int,
 
     def run_all(state, tr_full, tr_len_full):
         def seg_body(si, carry):
-            st, stalled = carry
+            st, stalled, calls0 = carry
             tr_seg = jax.lax.dynamic_slice_in_dim(
                 tr_full, si * window, window, axis=1
             )
@@ -1102,12 +1134,15 @@ def _build_run(config: SystemConfig, b: int, bb: int, k: int,
                 s2, calls = c
                 return call(s2, traces), calls + 1
 
-            st, _ = jax.lax.while_loop(cond, body, (st, jnp.int32(0)))
+            # the call counter carries ACROSS windows so max_calls
+            # (derived from the caller's max_cycles) bounds the whole
+            # run, not each window separately
+            st, calls1 = jax.lax.while_loop(cond, body, (st, calls0))
             stalled = stalled | ~all_quiescent(st, tl_seg)
-            return st, stalled
+            return st, stalled, calls1
 
-        state, stalled = jax.lax.fori_loop(
-            0, n_seg, seg_body, (state, jnp.bool_(False))
+        state, stalled, _ = jax.lax.fori_loop(
+            0, n_seg, seg_body, (state, jnp.bool_(False), jnp.int32(0))
         )
         overflow = jnp.any(state["scalars"][_SC_OVERFLOW] > 0)
         status = (
@@ -1238,8 +1273,9 @@ class PallasEngine:
             )
         if status & 1:
             raise StallError(
-                f"no quiescence within ~{max_cycles} cycles of a trace "
-                "window (livelock? use Semantics.robust())"
+                f"no quiescence within ~{max_cycles} cycles over the "
+                "whole run (livelock? use Semantics.robust(); raise "
+                "max_cycles for long windowed workloads)"
             )
         self._completed = True
         return self

@@ -10,6 +10,11 @@ import sys
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "sweep: randomized cross-engine differential sweep "
+        "(tests/test_random_differential.py)",
+    )
     # The axon sitecustomize registers the TPU PJRT plugin at
     # interpreter startup and pins the backend, so an in-process
     # JAX_PLATFORMS override is too late — re-exec once with a clean
@@ -49,11 +54,3 @@ def reference_tests_dir():
     if not REFERENCE_TESTS.is_dir():
         pytest.skip("reference test corpus not available")
     return REFERENCE_TESTS
-
-
-def pytest_configure(config):
-    config.addinivalue_line(
-        "markers",
-        "sweep: randomized cross-engine differential sweep "
-        "(tests/test_random_differential.py)",
-    )

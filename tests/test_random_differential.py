@@ -128,7 +128,9 @@ def test_random_differential_geometry(gi, tmp_path):
             assert spec.instructions == jx.instructions
             assert spec.messages == jx.messages
 
-        if pe is not None:
+        if pe is not None and not spec_stalled:
+            # (a stalled system would have raised in pe.run() above;
+            # guard anyway so `want` is never read undefined)
             assert _dicts(pe.system_final_dumps(b)) == want, (
                 f"pallas diverged b={b}"
             )
