@@ -28,16 +28,40 @@ from typing import List, Optional
 from hpa2_tpu.config import Semantics, SystemConfig
 
 
+_QUIRK_FIELDS = {
+    "eager-write": "eager_write_request_memory",
+    "flush-old-fill": "flush_invack_fills_old_value",
+    "overloaded-notify": "overloaded_evict_shared_notify",
+}
+
+
 def _build_config(args) -> SystemConfig:
+    import dataclasses
+
     sem = Semantics()
     if args.head_quirks:
-        if getattr(args, "backend", "spec") != "spec":
-            raise SystemExit(
-                "--head-quirks is only implemented by the spec engine "
-                "(use --backend spec); the jax/omp backends run fixture "
-                "semantics (SURVEY.md §6.2)"
-            )
         sem = sem.head_quirks()
+    for name in args.quirks.split(",") if args.quirks else []:
+        field = _QUIRK_FIELDS.get(name.strip())
+        if field is None:
+            raise SystemExit(
+                f"unknown quirk {name.strip()!r}; choose from "
+                + ", ".join(sorted(_QUIRK_FIELDS))
+            )
+        sem = dataclasses.replace(sem, **{field: True})
+    # per-quirk backend validation: the two value quirks are
+    # implemented by every backend; only the overloaded EVICT_SHARED
+    # upgrade-notify is spec/omp-only (the jit engines' fixed-shape
+    # handler grid has no lowering for HEAD's receiver==home
+    # disambiguation — ops/step.py:183-188)
+    backend = getattr(args, "backend", "spec")
+    if sem.overloaded_evict_shared_notify and backend in ("jax", "pallas"):
+        raise SystemExit(
+            "the overloaded-notify quirk is implemented by the spec and "
+            "omp backends only; the jax/pallas engines support the "
+            "eager-write and flush-old-fill quirks "
+            "(--quirks eager-write,flush-old-fill)"
+        )
     if args.robust:
         sem = sem.robust()
     return SystemConfig(
@@ -443,8 +467,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument(
         "--head-quirks", action="store_true",
-        help="emulate reference-HEAD divergences from its own fixtures "
-        "(SURVEY.md §6.2)",
+        help="emulate ALL reference-HEAD divergences from its own "
+        "fixtures (SURVEY.md §6.2); spec and omp backends",
+    )
+    p.add_argument(
+        "--quirks", default="", metavar="LIST",
+        help="comma-separated HEAD quirks to enable individually: "
+        "eager-write, flush-old-fill (all backends), "
+        "overloaded-notify (spec/omp only)",
     )
     p.add_argument(
         "--free-running", action="store_true",

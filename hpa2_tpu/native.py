@@ -83,6 +83,19 @@ def _check_config(config: SystemConfig) -> None:
         )
 
 
+def _sem_flags(config: SystemConfig) -> int:
+    """Semantics bitmask for the C API (capi.cpp apply_sem_flags).
+    Bit 0 is the historical 0/1 'robust' encoding, so the mask stays
+    ABI-compatible with older callers/libraries."""
+    sem = config.semantics
+    return (
+        (1 if sem.intervention_miss_policy == "nack" else 0)
+        | (2 if sem.eager_write_request_memory else 0)
+        | (4 if sem.flush_invack_fills_old_value else 0)
+        | (8 if sem.overloaded_evict_shared_notify else 0)
+    )
+
+
 def run_trace_dir(
     config: SystemConfig,
     trace_dir: str,
@@ -113,7 +126,7 @@ def run_trace_dir(
         1 if mode == "omp" else 0,
         config.num_procs, config.cache_size, config.mem_size,
         config.msg_buffer_size, config.max_instr_num,
-        1 if config.semantics.intervention_miss_policy == "nack" else 0,
+        _sem_flags(config),
         (replay_path or "").encode(), int(candidates), int(final_dump),
         max_cycles, threads, (record_order_path or "").encode(),
         (msg_trace_path or "").encode(),
@@ -139,7 +152,7 @@ def bench_random(
         1 if mode == "omp" else 0,
         config.num_procs, config.cache_size, config.mem_size,
         config.msg_buffer_size, instrs_per_core, seed,
-        1 if config.semantics.intervention_miss_policy == "nack" else 0,
+        _sem_flags(config),
         threads, ctypes.byref(res),
     )
     if rc != 0 or not res.ok:

@@ -150,6 +150,11 @@ _MEM_ROW = re.compile(
 _DIR_ROW = re.compile(
     r"^\|\s*(\d+)\s*\|\s*0x([0-9A-Fa-f]{2})\s*\|\s*(EM|S|U)\s*\|\s*0x([01]{8})\s*\|$"
 )
+# reference HEAD prints the sharer byte as raw hex via 0x%08X
+# (assignment.c:858-860) instead of the fixtures' binary digits
+_DIR_ROW_HEX = re.compile(
+    r"^\|\s*(\d+)\s*\|\s*0x([0-9A-Fa-f]{2})\s*\|\s*(EM|S|U)\s*\|\s*0x([0-9A-Fa-f]{8})\s*\|$"
+)
 _CACHE_ROW = re.compile(
     r"^\|\s*(\d+)\s*\|\s*0x([0-9A-Fa-f]{2})\s*\|\s*(\d+)\s*\|\s*"
     r"(MODIFIED|EXCLUSIVE|SHARED|INVALID)\s*\t\|$"
@@ -157,8 +162,13 @@ _CACHE_ROW = re.compile(
 _PROC_LINE = re.compile(r"^ Processor Node: (\d+)$")
 
 
-def parse_processor_dump(text: str) -> NodeDump:
-    """Parse a parity-format dump (fixture or fresh) back into NodeDump."""
+def parse_processor_dump(text: str, sharers_hex: bool = False) -> NodeDump:
+    """Parse a parity-format dump (fixture or fresh) back into NodeDump.
+
+    ``sharers_hex=True`` reads the bitVector column as the raw hex
+    byte reference HEAD prints (assignment.c:858-860) instead of the
+    fixtures' binary-digit rendering — for ingesting dumps produced by
+    the actual reference binary in HEAD-differential studies."""
     proc_id = None
     memory: List[int] = []
     dir_state: List[DirState] = []
@@ -187,10 +197,10 @@ def parse_processor_dump(text: str) -> NodeDump:
             if m:
                 memory.append(int(m.group(3)))
         elif section == "dir":
-            m = _DIR_ROW.match(line)
+            m = (_DIR_ROW_HEX if sharers_hex else _DIR_ROW).match(line)
             if m:
                 dir_state.append(DirState[m.group(3)])
-                dir_sharers.append(int(m.group(4), 2))
+                dir_sharers.append(int(m.group(4), 2 if not sharers_hex else 16))
         elif section == "cache":
             m = _CACHE_ROW.match(line)
             if m:

@@ -242,6 +242,87 @@ def gen_local_only(
     return traces
 
 
+def gen_eviction_pingpong(
+    config: SystemConfig,
+    instrs_per_core: int,
+    seed: int = 0,
+    hot_homes: int = 2,
+    write_frac: float = 0.1,
+) -> List[List[Instr]]:
+    """Adversarial liveness workload biased toward the reference's
+    hang class (SURVEY.md §6.3; VERDICT round-4 item 8).
+
+    Every generated address collides at cache index 0 (the test_4
+    pattern — 0x00/0x20/0x30/0x3C all map to index 0,
+    assignment.c:179, 603).  A few of them are "hot" lines that every
+    node — *including their own home* — repeatedly reads, so homes
+    become sharers of their own blocks; touching any other address
+    evicts the hot line from the direct-mapped slot and sends
+    EVICT_SHARED to its home.  The resulting eviction ping-pong +
+    last-sharer upgrade-notify interleavings are exactly the class
+    that livelocks reference HEAD (assignment.c:498-539) and that the
+    NACK/UPGRADE_NOTIFY redesign must survive.
+    """
+    import numpy as np
+
+    if config.cache_size > config.mem_size:
+        raise ValueError(
+            "gen_eviction_pingpong needs cache_size <= mem_size "
+            "(index-0 collisions must exist in every home's slice)"
+        )
+    rng = np.random.default_rng(seed)
+    n, c, m = config.num_procs, config.cache_size, config.mem_size
+
+    def index0_block(home: int) -> int:
+        # smallest b with (home*m + b) % c == 0; b < c <= m
+        return (-home * m) % c
+
+    homes = rng.permutation(n)[: max(1, min(hot_homes, n))]
+    hot = [config.make_addr(int(h), index0_block(int(h))) for h in homes]
+    colliders = [
+        config.make_addr(h, b)
+        for h in range(n)
+        for b in range(index0_block(h), m, c)
+        if config.make_addr(h, b) not in hot
+    ]
+    if not colliders:  # degenerate geometry (m == c, every home hot)
+        colliders = hot
+    traces = []
+    for _ in range(n):
+        tr = []
+        for _ in range(instrs_per_core):
+            r = rng.random()
+            if r < write_frac:
+                tr.append(
+                    Instr("W", int(rng.choice(hot)),
+                          int(rng.integers(0, 256)))
+                )
+            elif r < 0.65:
+                tr.append(Instr("R", int(rng.choice(hot))))
+            else:
+                tr.append(Instr("R", int(rng.choice(colliders))))
+        traces.append(tr)
+    return traces
+
+
+def gen_eviction_pingpong_arrays(
+    config: SystemConfig,
+    batch: int,
+    instrs_per_core: int,
+    seed: int = 0,
+    **kw,
+):
+    """Batched :func:`gen_eviction_pingpong` as ``[B, N, T]`` arrays."""
+    return traces_to_arrays(
+        config,
+        [
+            gen_eviction_pingpong(config, instrs_per_core,
+                                  seed=seed + b, **kw)
+            for b in range(batch)
+        ],
+    )
+
+
 def gen_uniform_random_arrays(
     config: SystemConfig,
     batch: int,

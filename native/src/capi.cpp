@@ -30,13 +30,23 @@ static void set_err(Hpa2Result* r, const std::string& e) {
   r->error[sizeof(r->error) - 1] = 0;
 }
 
+// Semantics bitmask (hpa2_tpu/native.py _sem_flags).  Bit 0 keeps the
+// historical 0/1 "robust" encoding valid, so old and new callers stay
+// ABI-compatible across a rebuild.
+static void apply_sem_flags(Config* cfg, int sem_flags) {
+  cfg->nack = (sem_flags & 1) != 0;
+  cfg->eager_write_request_memory = (sem_flags & 2) != 0;
+  cfg->flush_invack_fills_old_value = (sem_flags & 4) != 0;
+  cfg->overloaded_evict_shared_notify = (sem_flags & 8) != 0;
+}
+
 // Run a trace directory; writes core_<n>_output.txt into out_dir.
 // mode: 0 = lockstep, 1 = omp.  replay_path may be NULL.
 // record_order_path (may be NULL/empty): write the executed issue
 // interleaving there in DEBUG_INSTR format (assignment.c:596-597).
 int hpa2_run_dir(const char* trace_dir, const char* out_dir, int mode,
                  int nodes, int cache, int mem, int cap, int max_instr,
-                 int robust, const char* replay_path, int candidates,
+                 int sem_flags, const char* replay_path, int candidates,
                  int final_dump, unsigned long long max_cycles,
                  int threads, const char* record_order_path,
                  const char* msg_trace_path, Hpa2Result* result) {
@@ -46,7 +56,7 @@ int hpa2_run_dir(const char* trace_dir, const char* out_dir, int mode,
   cfg.mem = mem;
   cfg.cap = cap;
   cfg.max_instr = max_instr;
-  cfg.nack = robust != 0;
+  apply_sem_flags(&cfg, sem_flags);
   std::memset(result, 0, sizeof(*result));
   try {
     auto traces = load_trace_dir(cfg, trace_dir);
@@ -106,14 +116,14 @@ int hpa2_run_dir(const char* trace_dir, const char* out_dir, int mode,
 // Synthetic uniform-random benchmark; returns ops/sec via result.
 int hpa2_bench_random(int mode, int nodes, int cache, int mem, int cap,
                       int instrs_per_core, unsigned long long seed,
-                      int robust, int threads, Hpa2Result* result) {
+                      int sem_flags, int threads, Hpa2Result* result) {
   Config cfg;
   cfg.nodes = nodes;
   cfg.cache = cache;
   cfg.mem = mem;
   cfg.cap = cap;
   cfg.max_instr = 0;
-  cfg.nack = robust != 0;
+  apply_sem_flags(&cfg, sem_flags);
   std::memset(result, 0, sizeof(*result));
   try {
     auto traces = gen_uniform_random(cfg, instrs_per_core, seed);

@@ -8,6 +8,13 @@
 //   --mode lockstep|omp   execution engine (default lockstep)
 //   --nodes N --cache C --mem M --cap K --max-instr I
 //   --robust              NACK stale interventions (heals livelocks)
+//   --head-quirks         reference-HEAD semantics: eager memory write
+//                         on WRITE_REQUEST, FLUSH_INVACK installs the
+//                         flushed old value, and the overloaded
+//                         EVICT_SHARED upgrade-notify (livelocks when
+//                         the home is a sharer — SURVEY.md §6.2/§6.3)
+//   --quirk NAME          one HEAD quirk: eager-write | flush-old-fill
+//                         | overloaded-notify (repeatable)
 //   --replay FILE         lockstep replay of an instruction_order.txt
 //   --record-order FILE   write the executed issue interleaving in
 //                         DEBUG_INSTR format (mints new fixture
@@ -64,6 +71,22 @@ int main(int argc, char** argv) {
     else if (a == "--cap") cfg.cap = std::stoi(next());
     else if (a == "--max-instr") cfg.max_instr = std::stoi(next());
     else if (a == "--robust") cfg.nack = true;
+    else if (a == "--head-quirks") {
+      cfg.eager_write_request_memory = true;
+      cfg.flush_invack_fills_old_value = true;
+      cfg.overloaded_evict_shared_notify = true;
+    } else if (a == "--quirk") {
+      std::string q = next();
+      if (q == "eager-write") cfg.eager_write_request_memory = true;
+      else if (q == "flush-old-fill")
+        cfg.flush_invack_fills_old_value = true;
+      else if (q == "overloaded-notify")
+        cfg.overloaded_evict_shared_notify = true;
+      else {
+        std::cerr << "unknown quirk " << q << "\n";
+        return 2;
+      }
+    }
     else if (a == "--replay") replay_path = next();
     else if (a == "--record-order") record_path = next();
     else if (a == "--trace-msgs") msg_trace_path = next();

@@ -317,21 +317,37 @@ void handle_msg(const Config& cfg, int self, NodeState& n, const Msg& msg,
     }
 
     case EVICT_SHARED: {
-      if (self == home && test_bit(dir->sharers, msg.sender)) {
-        dir->sharers &= ~bit(msg.sender);
-        int remaining = popcount(dir->sharers);
-        if (remaining == 0) {
-          dir->state = DirSt::U;
-        } else if (remaining == 1 && dir->state == DirSt::S) {
-          dir->state = DirSt::EM;
-          Msg u{};
-          u.type = UPGRADE_NOTIFY;
-          u.sender = self;
-          u.addr = msg.addr;
-          u.second = -1;
-          send(find_owner(dir->sharers), u);
+      if (self == home) {
+        // the home branch wins even when the message is HEAD's
+        // overloaded upgrade-notify arriving at a home-that-shares —
+        // destructively re-interpreted as an eviction, exactly the
+        // assignment.c:499-521 livelock mechanism (SURVEY.md §6.3)
+        if (test_bit(dir->sharers, msg.sender)) {
+          dir->sharers &= ~bit(msg.sender);
+          int remaining = popcount(dir->sharers);
+          if (remaining == 0) {
+            dir->state = DirSt::U;
+          } else if (remaining == 1 && dir->state == DirSt::S) {
+            dir->state = DirSt::EM;
+            Msg u{};
+            u.type = cfg.overloaded_evict_shared_notify
+                         ? EVICT_SHARED
+                         : UPGRADE_NOTIFY;
+            u.sender = self;
+            u.addr = msg.addr;
+            u.second = -1;
+            send(find_owner(dir->sharers), u);
+          }
         }
+      } else if (cfg.overloaded_evict_shared_notify) {
+        // HEAD's non-home branch (assignment.c:522-538): sender==home
+        // means "you are the last sharer — upgrade S to E"
+        if (msg.sender == home && line_match &&
+            line.state == CacheSt::S)
+          line.state = CacheSt::E;
       }
+      // a non-home EVICT_SHARED cannot occur in fixture semantics
+      // (the notify is the distinct UPGRADE_NOTIFY type)
       break;
     }
 

@@ -63,6 +63,12 @@ struct Config {
   bool nack = false;    // robust intervention policy
   bool eager_write_request_memory = false;  // HEAD quirk
   bool flush_invack_fills_old_value = false;  // HEAD quirk
+  // HEAD quirk: the home->survivor "upgrade to E" notification is an
+  // overloaded EVICT_SHARED disambiguated only by receiver==home
+  // (assignment.c:498-539) instead of the distinct UPGRADE_NOTIFY —
+  // faithfully livelocks when the home is itself a sharer
+  // (SURVEY.md §6.3).
+  bool overloaded_evict_shared_notify = false;
 
   int num_addresses() const { return nodes * mem; }
   bool parity_format() const {
