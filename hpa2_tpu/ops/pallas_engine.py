@@ -33,7 +33,12 @@ nearly free at small blocks):
   cycle;
 * blocks default to 1024 lanes so each op amortizes its fixed cost
   over 8x more systems, with a sliding ``trace_window`` keeping the
-  trace plane — the VMEM whale — small for long workloads.
+  trace plane — the VMEM whale — small for long workloads;
+* (round 5) put sites pre-encode their wire words: a candidate slot
+  is its packed words plus a receiver row (-1 = empty), so phase A
+  maintains no per-field slot rows, there is no end-of-phase encode,
+  and deferred sends merge back without a decode/re-encode round
+  trip — roughly halving the phase-A/C bookkeeping op count.
 
 Message fields are type(4) | sender | second+1 | addr | aux, packed to
 31 bits per word.  ``aux`` is a union the protocol never uses twice
@@ -137,7 +142,8 @@ def _mb_layout(config: SystemConfig):
     DEFERRED outbox words) is added when it fits the last word for
     free — it then replaces the separate ob_recv plane in VMEM.  The
     reference geometry packs type4+sender3+second4+addr7+aux9+recv4 =
-    31 bits exactly.  Wire (mailbox) words leave those bits zero."""
+    31 bits exactly.  Mailbox decodes never read those bits (a wire
+    word delivered from a deferred outbox entry carries them)."""
     n = config.num_procs
     fields = (
         ("type", 4),
@@ -244,23 +250,6 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             x = x & ((1 << wd) - 1)
         return x
 
-    def enc(type_, sender, second, addr, aux):
-        """Pack logical field rows into W word rows (any shape)."""
-        vals = {"type": type_, "sender": sender, "second": second + 1,
-                "addr": addr, "aux": aux}
-        out = []
-        for w in range(W):
-            acc = None
-            for name, (ww, off, wd) in layout.items():
-                if ww != w or name == "recv":
-                    continue  # recv rides only DEFERRED (outbox) words
-                x = vals[name]
-                if off:
-                    x = x << off
-                acc = x if acc is None else acc | x
-            out.append(acc)
-        return out
-
     def cycle(s: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
         s = dict(s)
         # iotas are built inside the traced body (a pallas kernel may
@@ -341,41 +330,68 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
 
         zero = jnp.zeros((n, bb), dtype=I32)
         false = jnp.zeros((n, bb), dtype=bool)
+        neg1_nb = jnp.full((n, bb), -1, I32)
 
+        # --- pre-encoded put-words (PERF.md round-4 lever 2) ---------
+        # A candidate slot is its WIRE WORDS plus a receiver row
+        # (-1 = empty).  Each put site ORs compile-time-constant
+        # fields (the message type, usually the second-receiver
+        # sentinel) into the runtime ones directly, so there are no
+        # per-field slot rows to maintain, no end-of-phase re-encode,
+        # and deferred outbox entries merge back as already-packed
+        # words.  This halves the phase-A op count vs the field-row
+        # formulation (the kernel cost is op dispatch, not data width
+        # — scripts/micro_kernels.py).  The sender field (always the
+        # row index) is OR'd in once per slot at delivery prep.
         def slot():
-            # valid is i32 0/1, not bool: slot rows are indexed,
-            # broadcast, and stacked below, all Mosaic i8<->i1 hazards
-            # for bool vectors
-            return {
-                "valid": zero, "recv": zero, "type": zero, "addr": zero,
-                "aux": zero, "second": jnp.full((n, bb), -1, I32),
-            }
+            d = {"recv": neg1_nb}
+            for w in range(W):
+                d[f"w{w}"] = zero
+            return d
 
-        def put(sl, mask, recv, type_, addr, aux=None, second=None):
-            sl["valid"] = jnp.where(mask, 1, sl["valid"])
-            sl["recv"] = jnp.where(mask, recv, sl["recv"])
-            sl["type"] = jnp.where(mask, type_, sl["type"])
-            sl["addr"] = jnp.where(mask, addr, sl["addr"])
+        def pack(type_, addr, aux=None, second=None):
+            """Wire words [W x [N,B]] with the sender field left zero.
+            ``type_``/``aux`` may be python ints (constant-folded);
+            ``second`` is the node id (stored +1; None = none)."""
+            vals = {"type": type_, "addr": addr}
             if aux is not None:
-                sl["aux"] = jnp.where(mask, aux, sl["aux"])
+                vals["aux"] = aux
             if second is not None:
-                sl["second"] = jnp.where(mask, second, sl["second"])
+                vals["second"] = second + 1
+            out = []
+            for w in range(W):
+                acc = None
+                const = 0
+                for name, x in vals.items():
+                    ww, off, _ = layout[name]
+                    if ww != w:
+                        continue
+                    if isinstance(x, int):
+                        const |= x << off
+                        continue
+                    if off:
+                        x = x << off
+                    acc = x if acc is None else acc | x
+                if const:
+                    acc = const if acc is None else acc | const
+                out.append(zero if acc is None else acc)
+            return out
+
+        def put(sl, mask, recv, words):
+            sl["recv"] = jnp.where(mask, recv, sl["recv"])
+            for w in range(W):
+                sl[f"w{w}"] = jnp.where(mask, words[w], sl[f"w{w}"])
 
         def evict_msg(sl, mask, l_addr, l_val, l_state):
             """handleCacheReplacement (assignment.c:742-773)."""
             vv = mask & (l_addr != _INVALID_ADDR) & (l_state != _I)
             sane = jnp.maximum(l_addr, 0)
-            put(
-                sl, vv,
-                recv=sane // m,
-                type_=jnp.where(
-                    l_state == _M,
-                    int(MsgType.EVICT_MODIFIED),
-                    int(MsgType.EVICT_SHARED),
-                ),
-                addr=sane,
-                aux=l_val,
+            et = jnp.where(
+                l_state == _M,
+                int(MsgType.EVICT_MODIFIED),
+                int(MsgType.EVICT_SHARED),
             )
+            put(sl, vv, sane // m, pack(et, sane, aux=l_val))
             return vv
 
         sA0, sA1 = slot(), slot()
@@ -403,11 +419,12 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         du, dss, dem = ds == _DU, ds == _DS, ds == _EM
         reply_mask = mk & (du | dss | (dem & owner_is_snd))
         excl = du | (dem & owner_is_snd)
-        put(sA0, reply_mask, recv=snd, type_=int(MsgType.REPLY_RD),
-            addr=a, aux=mem_blk | jnp.where(excl, I32(256), I32(0)))
+        put(sA0, reply_mask, snd,
+            pack(int(MsgType.REPLY_RD), a,
+                 aux=mem_blk | jnp.where(excl, I32(256), I32(0))))
         fwd = mk & dem & ~owner_is_snd
-        put(sA0, fwd, recv=owner, type_=int(MsgType.WRITEBACK_INT),
-            addr=a, second=snd)
+        put(sA0, fwd, owner,
+            pack(int(MsgType.WRITEBACK_INT), a, second=snd))
         upd_dir = upd_dir | (mk & (du | dss | fwd))
         nd_state = jnp.where(mk & du, _EM, nd_state)
         nd_state = jnp.where(fwd, _DS, nd_state)
@@ -432,15 +449,14 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         # --- WRITEBACK_INT (assignment.c:249-271) --------------------
         mk = typ(MsgType.WRITEBACK_INT)
         ok = mk & line_match & line_me
-        put(sA0, ok, recv=home, type_=int(MsgType.FLUSH), addr=a,
-            aux=line_val, second=sr)
-        put(sA1, ok & (sr != home), recv=sr, type_=int(MsgType.FLUSH),
-            addr=a, aux=line_val, second=sr)
+        flush_w = pack(int(MsgType.FLUSH), a, aux=line_val, second=sr)
+        put(sA0, ok, home, flush_w)
+        put(sA1, ok & (sr != home), sr, flush_w)
         upd_line = upd_line | ok
         nl_state = jnp.where(ok, _S, nl_state)
         if nack:
-            put(sA0, mk & ~(line_match & line_me), recv=home,
-                type_=int(MsgType.NACK), addr=a, second=sr)
+            put(sA0, mk & ~(line_match & line_me), home,
+                pack(int(MsgType.NACK), a, second=sr))
 
         # --- FLUSH (assignment.c:273-296) ----------------------------
         mk = typ(MsgType.FLUSH)
@@ -459,8 +475,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         # --- UPGRADE (assignment.c:298-328) --------------------------
         mk = typ(MsgType.UPGRADE) & is_home
         reply_sh = jnp.where(mk & (ds == _DS), dsh & ~snd_bit, 0)
-        put(sA0, mk, recv=snd, type_=int(MsgType.REPLY_ID), addr=a,
-            aux=reply_sh)
+        put(sA0, mk, snd, pack(int(MsgType.REPLY_ID), a, aux=reply_sh))
         upd_dir = upd_dir | mk
         nd_state = jnp.where(mk, _EM, nd_state)
         nd_sharers = jnp.where(mk, snd_bit, nd_sharers)
@@ -490,13 +505,13 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             mem_write = mem_write | mk
             mem_val = jnp.where(mk, v, mem_val)
         du, dss, dem = ds == _DU, ds == _DS, ds == _EM
-        put(sA0, mk & (du | (dem & owner_is_snd)), recv=snd,
-            type_=int(MsgType.REPLY_WR), addr=a)
-        put(sA0, mk & dss, recv=snd, type_=int(MsgType.REPLY_ID),
-            addr=a, aux=dsh & ~snd_bit)
+        put(sA0, mk & (du | (dem & owner_is_snd)), snd,
+            pack(int(MsgType.REPLY_WR), a))
+        put(sA0, mk & dss, snd,
+            pack(int(MsgType.REPLY_ID), a, aux=dsh & ~snd_bit))
         wr_fwd = mk & dem & ~owner_is_snd
-        put(sA0, wr_fwd, recv=owner, type_=int(MsgType.WRITEBACK_INV),
-            addr=a, second=snd)
+        put(sA0, wr_fwd, owner,
+            pack(int(MsgType.WRITEBACK_INV), a, second=snd))
         upd_dir = upd_dir | (mk & (du | dss | wr_fwd))
         nd_state = jnp.where(mk & (du | dss), _EM, nd_state)
         nd_sharers = jnp.where(mk & (du | dss | wr_fwd), snd_bit, nd_sharers)
@@ -512,17 +527,15 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         # --- WRITEBACK_INV (assignment.c:451-473) --------------------
         mk = typ(MsgType.WRITEBACK_INV)
         ok = mk & line_match & line_me
-        put(sA0, ok, recv=home, type_=int(MsgType.FLUSH_INVACK),
-            addr=a, aux=line_val, second=sr)
-        put(sA1, ok & (sr != home), recv=sr,
-            type_=int(MsgType.FLUSH_INVACK), addr=a, aux=line_val,
-            second=sr)
+        invack_w = pack(int(MsgType.FLUSH_INVACK), a, aux=line_val,
+                        second=sr)
+        put(sA0, ok, home, invack_w)
+        put(sA1, ok & (sr != home), sr, invack_w)
         upd_line = upd_line | ok
         nl_state = jnp.where(ok, _I, nl_state)
         if nack:
-            put(sA0, mk & ~(line_match & line_me), recv=home,
-                type_=int(MsgType.NACK), addr=a,
-                aux=jnp.full_like(zero, 1), second=sr)
+            put(sA0, mk & ~(line_match & line_me), home,
+                pack(int(MsgType.NACK), a, aux=1, second=sr))
 
         # --- FLUSH_INVACK (assignment.c:475-496) ---------------------
         mk = typ(MsgType.FLUSH_INVACK)
@@ -550,8 +563,8 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         nd_state = jnp.where(mk & (cnt == 0), _DU, nd_state)
         upg = mk & (cnt == 1) & (ds == _DS)
         nd_state = jnp.where(upg, _EM, nd_state)
-        put(sA0, upg, recv=_find_owner(after),
-            type_=int(MsgType.UPGRADE_NOTIFY), addr=a)
+        put(sA0, upg, _find_owner(after),
+            pack(int(MsgType.UPGRADE_NOTIFY), a))
 
         # --- UPGRADE_NOTIFY (fixture semantics; spec_engine) ---------
         mk = typ(MsgType.UPGRADE_NOTIFY) & (snd == home)
@@ -579,9 +592,8 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             nd_state = jnp.where(wr, _EM, nd_state)
             nd_sharers = jnp.where(rd, nd_sharers | sr_bit, nd_sharers)
             nd_sharers = jnp.where(wr, sr_bit, nd_sharers)
-            put(sA0, rd, recv=sr, type_=int(MsgType.REPLY_RD), addr=a,
-                aux=mem_blk)
-            put(sA0, wr, recv=sr, type_=int(MsgType.REPLY_WR), addr=a)
+            put(sA0, rd, sr, pack(int(MsgType.REPLY_RD), a, aux=mem_blk))
+            put(sA0, wr, sr, pack(int(MsgType.REPLY_WR), a))
 
         # apply phase-A updates: the three cache/directory structures
         # share their packed word, so each applies in ONE one-hot write
@@ -629,12 +641,12 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         rm = is_rd & ~hit
         wm = is_wr & ~hit
         ev_issue = evict_msg(sB0, rm | wm, l2_addr, l2_val, l2_state)
-        put(sB1, rm, recv=home2, type_=int(MsgType.READ_REQUEST), addr=ia)
-        put(sB1, wm, recv=home2, type_=int(MsgType.WRITE_REQUEST),
-            addr=ia, aux=iv)
+        put(sB1, rm, home2, pack(int(MsgType.READ_REQUEST), ia))
+        put(sB1, wm, home2,
+            pack(int(MsgType.WRITE_REQUEST), ia, aux=iv))
         wh_me = is_wr & hit & ((l2_state == _M) | (l2_state == _E))
         wh_s = is_wr & hit & (l2_state == _S)
-        put(sB1, wh_s, recv=home2, type_=int(MsgType.UPGRADE), addr=ia)
+        put(sB1, wh_s, home2, pack(int(MsgType.UPGRADE), ia))
 
         pending_write = jnp.where(is_wr, iv, s["pending_write"])
         waiting = jnp.where(rm | wm | wh_s, 1, waiting)
@@ -652,25 +664,24 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         cachew = write_c(cachew, ci2, i_upd, cw2_val)
         pc = s["pc"] + elig.astype(I32)
 
-        # merge deferred sends back into their candidate-grid slots
-        # (blocked nodes made no new sends, so the where-merge is exact)
+        # merge deferred sends back into their candidate-grid slots as
+        # ALREADY-PACKED words (blocked nodes made no new sends, so the
+        # where-merge is exact).  Stray recv-field bits riding a merged
+        # wire word are harmless: no mailbox decode reads them.  The
+        # INV slot stays decoded (its remainder mask must be re-derived
+        # each cycle, and its word re-packed clean of the old mask).
         obv = s["ob_valid"]
 
         def merge_slot(sl, k):
             pv = obv[:, k, :] != 0
             words = [s[f"ob{w}"][:, k, :] for w in range(W)]
-            sl["valid"] = jnp.where(pv, 1, sl["valid"])
             old_recv = (
                 dec(words, "recv") - 1 if recv_packed
                 else s["ob_recv"][:, k, :]
             )
             sl["recv"] = jnp.where(pv, old_recv, sl["recv"])
-            sl["type"] = jnp.where(pv, dec(words, "type"), sl["type"])
-            sl["addr"] = jnp.where(pv, dec(words, "addr"), sl["addr"])
-            sl["aux"] = jnp.where(pv, dec(words, "aux"), sl["aux"])
-            sl["second"] = jnp.where(
-                pv, dec(words, "second") - 1, sl["second"]
-            )
+            for w in range(W):
+                sl[f"w{w}"] = jnp.where(pv, words[w], sl[f"w{w}"])
 
         merge_slot(sA0, 0)
         merge_slot(sA1, 1)
@@ -696,16 +707,20 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         # counter/rejection sums after it (order-free), leaving only
         # position/acceptance/write ops inside.
         aux_w, aux_off, _ = layout["aux"]
-        neg1_nb = jnp.full((n, bb), -1, I32)
-        sinv = {
-            "type": jnp.full((n, bb), int(MsgType.INV), I32),
-            "addr": inv_addr, "aux": zero, "second": neg1_nb,
-            "recv": neg1_nb,
-        }
+        sinv = slot()
+        for w, wd_ in zip(range(W), pack(int(MsgType.INV), inv_addr)):
+            sinv[f"w{w}"] = wd_
         slots5 = (sA0, sA1, sinv, sB0, sB1)
-        # per-slot packed words [N, B] (sender = node index)
+        # wire words [N, B] per slot: the sender field (the node's own
+        # row index) is OR'd in once here, not at every put site
+        sender_w, sender_off, _ = layout["sender"]
+        base_sender = iota_n << sender_off if sender_off else iota_n
         words5 = [
-            enc(sl["type"], iota_n, sl["second"], sl["addr"], sl["aux"])
+            [
+                sl[f"w{w}"] | base_sender if w == sender_w
+                else sl[f"w{w}"]
+                for w in range(W)
+            ]
             for sl in slots5
         ]
 
@@ -729,17 +744,11 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             acc_masks[k][sender] = acc_i
             return mbs, acc + acc_i
 
-        # per-slot receiver map: -1 where the slot is empty, so the
-        # per-sender validity check is ONE i32 row broadcast + compare
+        # the receiver row IS the validity map (-1 = empty slot), so
+        # the per-sender check is ONE i32 row broadcast + compare
         # (bool rows can't be indexed/broadcast Mosaic-safely)
-        def tgt_of(sl):
-            return jnp.where(sl["valid"] != 0, sl["recv"], -1)
-
-        tgtA0, tgtA1 = tgt_of(sA0), tgt_of(sA1)
-        tgtB0, tgtB1 = tgt_of(sB0), tgt_of(sB1)
-
-        def point_valid(tgt, sender):
-            return iota_n == tgt[sender][None, :]
+        def point_valid(sl, sender):
+            return iota_n == sl["recv"][sender][None, :]
 
         def inv_valid(sender):
             return ((inv_sharers[sender][None, :] >> iota_n) & 1) == 1
@@ -751,16 +760,16 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         else:
             for sender in range(n):
                 mbs, acc = candidate(mbs, acc, 0, sender,
-                                     point_valid(tgtA0, sender))
+                                     point_valid(sA0, sender))
                 mbs, acc = candidate(mbs, acc, 1, sender,
-                                     point_valid(tgtA1, sender))
+                                     point_valid(sA1, sender))
                 mbs, acc = candidate(mbs, acc, 2, sender,
                                      inv_valid(sender))
             for sender in range(n):
                 mbs, acc = candidate(mbs, acc, 3, sender,
-                                     point_valid(tgtB0, sender))
+                                     point_valid(sB0, sender))
                 mbs, acc = candidate(mbs, acc, 4, sender,
-                                     point_valid(tgtB1, sender))
+                                     point_valid(sB1, sender))
 
         # post-loop bookkeeping on stacked masks (sums are order-free;
         # masks are already i32 — stacking bool vectors is a Mosaic
@@ -771,8 +780,17 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         )                                      # [S(sender), 5, R(recv), B]
         dcount = jnp.sum(accs, axis=2)         # [S, 5, B] per candidate
         md = jnp.sum(dcount, axis=(0, 1))[None, :]          # [1, B]
+        # message-type decode straight off the wire word (empty slots
+        # decode as type 0 but contribute dcount 0)
+        tword, toff, twd = layout["type"]
         type_arr = jnp.stack(
-            [sl["type"] for sl in slots5], axis=1
+            [
+                (words5[k][tword] >> toff) & ((1 << twd) - 1)
+                if toff
+                else words5[k][tword] & ((1 << twd) - 1)
+                for k in range(_NSLOTS)
+            ],
+            axis=1,
         )                                      # [S, 5, B]
         mc = jnp.sum(
             jnp.where(
@@ -791,15 +809,16 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         inv_acc_bits = jnp.sum(accs[:, 2, :, :] << io_r, axis=1)
         remaining = inv_sharers & ~inv_acc_bits
         rej = [
-            jnp.where(dcount[:, k, :] == 0, slots5[k]["valid"], 0)
+            jnp.where(
+                (dcount[:, k, :] == 0) & (slots5[k]["recv"] >= 0), 1, 0
+            )
             for k in (0, 1, 3, 4)
         ]
         ob_valid_new = jnp.stack(
             [rej[0], rej[1], (remaining != 0).astype(I32),
              rej[2], rej[3]], axis=1,
         )                                      # [N, 5, B]
-        recvs5 = (sA0["recv"], sA1["recv"], neg1_nb,
-                  sB0["recv"], sB1["recv"])
+        recvs5 = tuple(sl["recv"] for sl in slots5)   # sinv recv = -1
         if not recv_packed:
             ob_recv_new = jnp.stack(recvs5, axis=1)
         ob_new = []
@@ -810,6 +829,8 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             if w == aux_w:
                 ws[2] = ws[2] | (remaining << aux_off)
             if recv_packed and w == recv_w:
+                # idempotent for merged-deferred rows (their words
+                # already carry the same recv bits)
                 ws = [
                     wk | ((recvs5[k] + 1) << recv_off)
                     for k, wk in enumerate(ws)

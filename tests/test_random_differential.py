@@ -117,14 +117,14 @@ def _sweep(cfg, batch, extra, arrays, tmp_path, allow_stall):
 
         spec = SpecEngine(cfg, traces)
         try:
-            spec.run(max_cycles=5_000)
+            spec.run(max_cycles=50_000)
             spec_stalled = False
         except StallError:
             spec_stalled = True
             stalled += 1
 
         # xla per system (compile shared across b: identical shapes)
-        jx = JaxEngine(cfg, traces, max_cycles=5_000)
+        jx = JaxEngine(cfg, traces, max_cycles=50_000)
         if spec_stalled:
             with pytest.raises(StallError):
                 jx.run()
@@ -156,12 +156,12 @@ def _sweep(cfg, batch, extra, arrays, tmp_path, allow_stall):
                                    match="livelock"):
                     native_mod.run_trace_dir(
                         cfg, str(tr_dir), str(out), mode="lockstep",
-                        final_dump=True, max_cycles=5_000,
+                        final_dump=True, max_cycles=50_000,
                     )
                 continue
             res = native_mod.run_trace_dir(
                 cfg, str(tr_dir), str(out), mode="lockstep",
-                final_dump=True, max_cycles=5_000,
+                final_dump=True, max_cycles=50_000,
             )
             assert int(res.instructions) == spec.instructions, (
                 f"native instrs diverged b={b}"
