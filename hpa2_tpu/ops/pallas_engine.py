@@ -65,9 +65,16 @@ selected against a scalar bool constant, or reduced (`arith.trunci
 i8->i1`, the BENCH_r03 compile failure) — masks live as i32 0/1 and
 comparisons happen at use sites; reductions are integer sums.
 
-Restrictions: ``num_procs <= 21`` (sharer mask must share the packed
-directory word; the XLA engine covers wider geometries), addresses
-< 2^21, no replay mode (fixture replays run on the XLA/spec engines).
+Node-count scaling (round 5): below 22 nodes the sharer mask shares
+the packed directory word (the fast path); beyond, the engine
+switches to SPLIT-PLANE mode — sharers live in ``SW = ceil(n/31)``
+dedicated ``dirs{w}`` planes and ride dedicated ``shr{w}`` message
+fields — same cycle semantics at any node count (the widened
+bitVector scaling axis, SURVEY.md §5; the reference caps at 8 via its
+1-byte bitVector, assignment.c:49).  Remaining restrictions:
+addresses < 2^21, no replay mode (fixture replays run on the
+XLA/spec engines).  The unrolled delivery loop is O(nodes) python at
+trace time, so very wide systems pay a long compile.
 """
 
 from __future__ import annotations
@@ -133,10 +140,34 @@ def _bits_for(n_values: int) -> int:
     return b
 
 
+# bits per sharer word in split-plane mode (sign-safe i32 shifts)
+_SPLIT_BPW = 31
+
+
+def _split_mode(config: SystemConfig) -> bool:
+    """num_procs <= 21: the sharer mask shares the packed directory
+    word (the fast path).  Beyond, sharers live in SW dedicated
+    ``dirs{w}`` planes of 31 bits each and messages carry them in
+    dedicated ``shr{w}`` fields — same cycle semantics, wider state
+    (the widened-bitVector scaling axis, SURVEY.md §5)."""
+    return config.num_procs > 21
+
+
+def _sharer_words(config: SystemConfig) -> int:
+    if not _split_mode(config):
+        return 1
+    return -(-config.num_procs // _SPLIT_BPW)
+
+
 @functools.lru_cache(maxsize=64)
 def _mb_layout(config: SystemConfig):
     """Field -> (word, offset, width) packing for one message, plus the
     word count W.  Words hold at most 31 bits (sign-safe shifts).
+
+    In split-plane mode (num_procs > 21) the ``aux`` union narrows to
+    its 9-bit value|excl role and sharer masks ride dedicated
+    ``shr{w}`` fields (one 31-bit field per sharer word, each on its
+    own message word).
 
     A trailing "recv" field (stored recv+1; only meaningful in
     DEFERRED outbox words) is added when it fits the last word for
@@ -145,13 +176,18 @@ def _mb_layout(config: SystemConfig):
     31 bits exactly.  Mailbox decodes never read those bits (a wire
     word delivered from a deferred outbox entry carries them)."""
     n = config.num_procs
-    fields = (
+    split = _split_mode(config)
+    fields = [
         ("type", 4),
         ("sender", _bits_for(n)),
         ("second", _bits_for(n + 1)),   # stored as second+1
         ("addr", _bits_for(config.num_addresses)),
-        ("aux", max(n, 9)),             # byte value | excl<<8, or mask
-    )
+        ("aux", 9 if split else max(n, 9)),  # byte value | excl<<8
+    ]
+    if split:
+        fields += [
+            (f"shr{w}", _SPLIT_BPW) for w in range(_sharer_words(config))
+        ]
     layout = {}
     word, off = 0, 0
     for name, wd in fields:
@@ -166,24 +202,24 @@ def _mb_layout(config: SystemConfig):
 
 
 def _check_geometry(config: SystemConfig) -> None:
-    if config.num_procs > 21:
-        raise ValueError(
-            "pallas engine supports num_procs <= 21 (packed directory "
-            "word); use the XLA engine for wider systems"
-        )
     if config.num_addresses >= (1 << 21):
         raise ValueError("pallas engine supports addresses < 2^21")
 
 
 #: per-engine carried state names, in kernel argument order
-def _state_fields(W: int, snapshots: bool, recv_packed: bool):
+def _state_fields(W: int, snapshots: bool, recv_packed: bool,
+                  split_sw: int = 0):
+    """``split_sw`` > 0 adds the split-plane sharer words (dirs{w},
+    plus their snapshot twins)."""
     f = ["cachew", "dirw"]
+    f += [f"dirs{w}" for w in range(split_sw)]
     f += [f"mb{w}" for w in range(W)]
     f += ["mb_count", "pc", "waiting", "pending_write"]
     f += [f"ob{w}" for w in range(W)]
     f += ([] if recv_packed else ["ob_recv"]) + ["ob_valid"]
     if snapshots:
         f += ["snap_taken", "snap_cachew", "snap_dirw"]
+        f += [f"snap_dirs{w}" for w in range(split_sw)]
     f += ["scalars", "msg_counts"]
     return tuple(f)
 
@@ -238,7 +274,9 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
     nack = sem.intervention_miss_policy == "nack"
     layout, W = _mb_layout(config)
     recv_packed = "recv" in layout
-    sh_mask = (1 << n) - 1
+    split = _split_mode(config)
+    SW = _sharer_words(config)
+    sh_mask = (1 << min(n, _SPLIT_BPW)) - 1
     addr_mask = (1 << 21) - 1
 
     def dec(words, name):
@@ -319,18 +357,73 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         dw = read_m(s["dirw"], blk)
         mem_blk = dw & 0xFF
         ds = (dw >> _DW_STATE_SHIFT) & 3
-        dsh = (dw >> _DW_SH_SHIFT) & sh_mask
         pw = s["pending_write"]
-
-        line_match = line_addr == a
-        line_me = (line_state == _M) | (line_state == _E)
-        owner = _find_owner(dsh)
-        owner_is_snd = owner == snd
-        snd_bit = _bit(snd)
 
         zero = jnp.zeros((n, bb), dtype=I32)
         false = jnp.zeros((n, bb), dtype=bool)
         neg1_nb = jnp.full((n, bb), -1, I32)
+
+        # --- sharer sets as SW-word vectors (SW == 1 packed in the
+        # directory word below 22 nodes; split dirs{w} planes beyond).
+        # All helpers reduce to the single-word ops when SW == 1.
+        if split:
+            dshw = [read_m(s[f"dirs{w}"], blk) for w in range(SW)]
+        else:
+            dshw = [(dw >> _DW_SH_SHIFT) & sh_mask]
+
+        def sv_bit(proc):
+            """One-hot sharer vector for node id(s); negative -> 0."""
+            if SW == 1:
+                return [_bit(proc)]
+            return [
+                _bit(
+                    jnp.where(
+                        (proc >= w * _SPLIT_BPW)
+                        & (proc < (w + 1) * _SPLIT_BPW),
+                        proc - w * _SPLIT_BPW,
+                        -1,
+                    )
+                )
+                for w in range(SW)
+            ]
+
+        def sv_test(sv, proc):
+            if SW == 1:
+                return _test_bit(sv[0], proc)
+            hit = zero
+            for w in range(SW):
+                b = proc - w * _SPLIT_BPW
+                vw = (sv[w] >> jnp.clip(b, 0, _SPLIT_BPW - 1)) & 1
+                hit = hit | jnp.where(
+                    (b >= 0) & (b < _SPLIT_BPW), vw, 0
+                )
+            return hit == 1
+
+        def sv_count(sv):
+            cnt = _popcount(sv[0])
+            for w in range(1, SW):
+                cnt = cnt + _popcount(sv[w])
+            return cnt
+
+        def sv_owner(sv):
+            """Lowest set bit across words (reference findOwner)."""
+            own = _find_owner(sv[SW - 1])
+            if SW > 1:
+                own = jnp.where(
+                    own >= 0, own + (SW - 1) * _SPLIT_BPW, own
+                )
+            for w in range(SW - 2, -1, -1):
+                cand = _find_owner(sv[w])
+                own = jnp.where(
+                    sv[w] != 0, cand + w * _SPLIT_BPW, own
+                )
+            return own
+
+        line_match = line_addr == a
+        line_me = (line_state == _M) | (line_state == _E)
+        owner = sv_owner(dshw)
+        owner_is_snd = owner == snd
+        snd_bitw = sv_bit(snd)
 
         # --- pre-encoded put-words (PERF.md round-4 lever 2) ---------
         # A candidate slot is its WIRE WORDS plus a receiver row
@@ -349,11 +442,19 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
                 d[f"w{w}"] = zero
             return d
 
-        def pack(type_, addr, aux=None, second=None):
+        def pack(type_, addr, aux=None, second=None, shr=None):
             """Wire words [W x [N,B]] with the sender field left zero.
             ``type_``/``aux`` may be python ints (constant-folded);
-            ``second`` is the node id (stored +1; None = none)."""
+            ``second`` is the node id (stored +1; None = none); ``shr``
+            is an SW-word sharer vector (split mode: rides the shr{w}
+            fields; packed mode: the single word IS the aux union)."""
             vals = {"type": type_, "addr": addr}
+            if shr is not None:
+                if split:
+                    for w_ in range(SW):
+                        vals[f"shr{w_}"] = shr[w_]
+                else:
+                    aux = shr[0]
             if aux is not None:
                 vals["aux"] = aux
             if second is not None:
@@ -395,12 +496,12 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             return vv
 
         sA0, sA1 = slot(), slot()
-        inv_sharers = zero
+        inv_shw = [zero] * SW
         inv_addr = zero
 
         nl_addr, nl_val, nl_state = line_addr, line_val, line_state
         upd_line = false
-        nd_state, nd_sharers = ds, dsh
+        nd_state, nd_shw = ds, list(dshw)
         upd_dir = false
         mem_write = false
         mem_val = mem_blk
@@ -428,10 +529,14 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         upd_dir = upd_dir | (mk & (du | dss | fwd))
         nd_state = jnp.where(mk & du, _EM, nd_state)
         nd_state = jnp.where(fwd, _DS, nd_state)
-        nd_sharers = jnp.where(mk & du, snd_bit, nd_sharers)
-        nd_sharers = jnp.where(
-            mk & (dss | fwd), nd_sharers | snd_bit, nd_sharers
-        )
+        nd_shw = [
+            jnp.where(mk & du, snd_bitw[w], nd_shw[w]) for w in range(SW)
+        ]
+        nd_shw = [
+            jnp.where(mk & (dss | fwd), nd_shw[w] | snd_bitw[w],
+                      nd_shw[w])
+            for w in range(SW)
+        ]
 
         # --- REPLY_RD (assignment.c:238-247) -------------------------
         mk = typ(MsgType.REPLY_RD)
@@ -474,11 +579,16 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
 
         # --- UPGRADE (assignment.c:298-328) --------------------------
         mk = typ(MsgType.UPGRADE) & is_home
-        reply_sh = jnp.where(mk & (ds == _DS), dsh & ~snd_bit, 0)
-        put(sA0, mk, snd, pack(int(MsgType.REPLY_ID), a, aux=reply_sh))
+        reply_shw = [
+            jnp.where(mk & (ds == _DS), dshw[w] & ~snd_bitw[w], 0)
+            for w in range(SW)
+        ]
+        put(sA0, mk, snd, pack(int(MsgType.REPLY_ID), a, shr=reply_shw))
         upd_dir = upd_dir | mk
         nd_state = jnp.where(mk, _EM, nd_state)
-        nd_sharers = jnp.where(mk, snd_bit, nd_sharers)
+        nd_shw = [
+            jnp.where(mk, snd_bitw[w], nd_shw[w]) for w in range(SW)
+        ]
 
         # --- REPLY_ID (assignment.c:330-364) -------------------------
         mk = typ(MsgType.REPLY_ID)
@@ -487,7 +597,15 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         nl_val = jnp.where(fill, pw, nl_val)
         nl_state = jnp.where(fill, _M, nl_state)
         fan = mk & line_match
-        inv_sharers = jnp.where(fan, aux & ~_bit(iota_n), inv_sharers)
+        if split:
+            msg_shw = [dec(heads, f"shr{w}") for w in range(SW)]
+        else:
+            msg_shw = [aux]
+        self_bitw = sv_bit(iota_n)
+        inv_shw = [
+            jnp.where(fan, msg_shw[w] & ~self_bitw[w], inv_shw[w])
+            for w in range(SW)
+        ]
         inv_addr = jnp.where(fan, a, inv_addr)
         waiting = jnp.where(mk, 0, waiting)
 
@@ -508,13 +626,17 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         put(sA0, mk & (du | (dem & owner_is_snd)), snd,
             pack(int(MsgType.REPLY_WR), a))
         put(sA0, mk & dss, snd,
-            pack(int(MsgType.REPLY_ID), a, aux=dsh & ~snd_bit))
+            pack(int(MsgType.REPLY_ID), a,
+                 shr=[dshw[w] & ~snd_bitw[w] for w in range(SW)]))
         wr_fwd = mk & dem & ~owner_is_snd
         put(sA0, wr_fwd, owner,
             pack(int(MsgType.WRITEBACK_INV), a, second=snd))
         upd_dir = upd_dir | (mk & (du | dss | wr_fwd))
         nd_state = jnp.where(mk & (du | dss), _EM, nd_state)
-        nd_sharers = jnp.where(mk & (du | dss | wr_fwd), snd_bit, nd_sharers)
+        nd_shw = [
+            jnp.where(mk & (du | dss | wr_fwd), snd_bitw[w], nd_shw[w])
+            for w in range(SW)
+        ]
 
         # --- REPLY_WR (assignment.c:437-449) -------------------------
         mk = typ(MsgType.REPLY_WR)
@@ -544,7 +666,10 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         mem_val = jnp.where(hm, v, mem_val)
         upd_dir = upd_dir | hm
         nd_state = jnp.where(hm, _EM, nd_state)
-        nd_sharers = jnp.where(hm, _bit(sr), nd_sharers)
+        sr_bitw = sv_bit(sr)
+        nd_shw = [
+            jnp.where(hm, sr_bitw[w], nd_shw[w]) for w in range(SW)
+        ]
         rq = mk & is_second
         upd_line = upd_line | rq
         nl_addr = jnp.where(rq, a, nl_addr)
@@ -555,15 +680,17 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         waiting = jnp.where(rq, 0, waiting)
 
         # --- EVICT_SHARED home role (assignment.c:498-521) -----------
-        mk = typ(MsgType.EVICT_SHARED) & is_home & _test_bit(dsh, snd)
-        after = dsh & ~snd_bit
-        cnt = _popcount(after)
+        mk = typ(MsgType.EVICT_SHARED) & is_home & sv_test(dshw, snd)
+        after = [dshw[w] & ~snd_bitw[w] for w in range(SW)]
+        cnt = sv_count(after)
         upd_dir = upd_dir | mk
-        nd_sharers = jnp.where(mk, after, nd_sharers)
+        nd_shw = [
+            jnp.where(mk, after[w], nd_shw[w]) for w in range(SW)
+        ]
         nd_state = jnp.where(mk & (cnt == 0), _DU, nd_state)
         upg = mk & (cnt == 1) & (ds == _DS)
         nd_state = jnp.where(upg, _EM, nd_state)
-        put(sA0, upg, _find_owner(after),
+        put(sA0, upg, sv_owner(after),
             pack(int(MsgType.UPGRADE_NOTIFY), a))
 
         # --- UPGRADE_NOTIFY (fixture semantics; spec_engine) ---------
@@ -576,22 +703,30 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         mk = typ(MsgType.EVICT_MODIFIED) & is_home
         mem_write = mem_write | mk
         mem_val = jnp.where(mk, v, mem_val)
-        drop = mk & (ds == _EM) & _test_bit(dsh, snd)
+        drop = mk & (ds == _EM) & sv_test(dshw, snd)
         upd_dir = upd_dir | drop
         nd_state = jnp.where(drop, _DU, nd_state)
-        nd_sharers = jnp.where(drop, 0, nd_sharers)
+        nd_shw = [
+            jnp.where(drop, 0, nd_shw[w]) for w in range(SW)
+        ]
 
         # --- NACK re-serve (robust mode; spec_engine) ----------------
         if nack:
             mk = typ(MsgType.NACK) & is_home
             rd = mk & (aux == 0)
             wr = mk & (aux != 0)
-            sr_bit = _bit(sr)
+            nack_sr_bitw = sv_bit(sr)
             upd_dir = upd_dir | mk
             nd_state = jnp.where(rd, _DS, nd_state)
             nd_state = jnp.where(wr, _EM, nd_state)
-            nd_sharers = jnp.where(rd, nd_sharers | sr_bit, nd_sharers)
-            nd_sharers = jnp.where(wr, sr_bit, nd_sharers)
+            nd_shw = [
+                jnp.where(rd, nd_shw[w] | nack_sr_bitw[w], nd_shw[w])
+                for w in range(SW)
+            ]
+            nd_shw = [
+                jnp.where(wr, nack_sr_bitw[w], nd_shw[w])
+                for w in range(SW)
+            ]
             put(sA0, rd, sr, pack(int(MsgType.REPLY_RD), a, aux=mem_blk))
             put(sA0, wr, sr, pack(int(MsgType.REPLY_WR), a))
 
@@ -604,11 +739,21 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         cachew = write_c(s["cachew"], ci, upd_line, cw_val)
         new_mem = jnp.where(mem_write, mem_val, mem_blk)
         new_ds = jnp.where(upd_dir, nd_state, ds)
-        new_dsh = jnp.where(upd_dir, nd_sharers, dsh)
-        dw_val = (
-            new_mem | (new_ds << _DW_STATE_SHIFT)
-            | (new_dsh << _DW_SH_SHIFT)
-        )
+        new_dshw = [
+            jnp.where(upd_dir, nd_shw[w], dshw[w]) for w in range(SW)
+        ]
+        if split:
+            dw_val = new_mem | (new_ds << _DW_STATE_SHIFT)
+            dirsp = [
+                write_m(s[f"dirs{w}"], blk, upd_dir, new_dshw[w])
+                for w in range(SW)
+            ]
+        else:
+            dw_val = (
+                new_mem | (new_ds << _DW_STATE_SHIFT)
+                | (new_dshw[0] << _DW_SH_SHIFT)
+            )
+            dirsp = []
         dirw = write_m(s["dirw"], blk, mem_write | upd_dir, dw_val)
 
         # ===== phase B: instruction issue ============================
@@ -687,7 +832,14 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         merge_slot(sA1, 1)
         pend_inv = obv[:, 2, :] != 0
         ob2 = [s[f"ob{w}"][:, 2, :] for w in range(W)]
-        inv_sharers = jnp.where(pend_inv, dec(ob2, "aux"), inv_sharers)
+        if split:
+            ob2_shw = [dec(ob2, f"shr{w}") for w in range(SW)]
+        else:
+            ob2_shw = [dec(ob2, "aux")]
+        inv_shw = [
+            jnp.where(pend_inv, ob2_shw[w], inv_shw[w])
+            for w in range(SW)
+        ]
         inv_addr = jnp.where(pend_inv, dec(ob2, "addr"), inv_addr)
         merge_slot(sB0, 3)
         merge_slot(sB1, 4)
@@ -706,7 +858,6 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         # ARE hoisted: per-slot encodes before the loop, stacked
         # counter/rejection sums after it (order-free), leaving only
         # position/acceptance/write ops inside.
-        aux_w, aux_off, _ = layout["aux"]
         sinv = slot()
         for w, wd_ in zip(range(W), pack(int(MsgType.INV), inv_addr)):
             sinv[f"w{w}"] = wd_
@@ -751,7 +902,19 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             return iota_n == sl["recv"][sender][None, :]
 
         def inv_valid(sender):
-            return ((inv_sharers[sender][None, :] >> iota_n) & 1) == 1
+            if SW == 1:
+                return ((inv_shw[0][sender][None, :] >> iota_n) & 1) == 1
+            acc_v = zero
+            for w in range(SW):
+                b = iota_n - w * _SPLIT_BPW
+                vw = (
+                    inv_shw[w][sender][None, :]
+                    >> jnp.clip(b, 0, _SPLIT_BPW - 1)
+                ) & 1
+                acc_v = acc_v | jnp.where(
+                    (b >= 0) & (b < _SPLIT_BPW), vw, 0
+                )
+            return acc_v == 1
 
         if "deliver" in ablate:
             for k_ in range(_NSLOTS):
@@ -782,15 +945,8 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         md = jnp.sum(dcount, axis=(0, 1))[None, :]          # [1, B]
         # message-type decode straight off the wire word (empty slots
         # decode as type 0 but contribute dcount 0)
-        tword, toff, twd = layout["type"]
         type_arr = jnp.stack(
-            [
-                (words5[k][tword] >> toff) & ((1 << twd) - 1)
-                if toff
-                else words5[k][tword] & ((1 << twd) - 1)
-                for k in range(_NSLOTS)
-            ],
-            axis=1,
+            [dec(words5[k], "type") for k in range(_NSLOTS)], axis=1
         )                                      # [S, 5, B]
         mc = jnp.sum(
             jnp.where(
@@ -804,10 +960,27 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
 
         # rejected candidates defer to the sender outbox; the INV
         # remainder (mask minus accepted receivers) rides the deferred
-        # word's aux field
-        io_r = jax.lax.broadcasted_iota(I32, (n, n, bb), 1)
-        inv_acc_bits = jnp.sum(accs[:, 2, :, :] << io_r, axis=1)
-        remaining = inv_sharers & ~inv_acc_bits
+        # word's aux union (packed) or shr{w} fields (split)
+        if SW == 1:
+            io_r = jax.lax.broadcasted_iota(I32, (n, n, bb), 1)
+            remaining = [
+                inv_shw[0] & ~jnp.sum(accs[:, 2, :, :] << io_r, axis=1)
+            ]
+        else:
+            remaining = []
+            for w in range(SW):
+                lo = w * _SPLIT_BPW
+                hi = min(n, lo + _SPLIT_BPW)
+                io_r = jax.lax.broadcasted_iota(
+                    I32, (n, hi - lo, bb), 1
+                )
+                remaining.append(
+                    inv_shw[w]
+                    & ~jnp.sum(accs[:, 2, lo:hi, :] << io_r, axis=1)
+                )
+        rem_any = remaining[0]
+        for w in range(1, SW):
+            rem_any = rem_any | remaining[w]
         rej = [
             jnp.where(
                 (dcount[:, k, :] == 0) & (slots5[k]["recv"] >= 0), 1, 0
@@ -815,7 +988,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             for k in (0, 1, 3, 4)
         ]
         ob_valid_new = jnp.stack(
-            [rej[0], rej[1], (remaining != 0).astype(I32),
+            [rej[0], rej[1], (rem_any != 0).astype(I32),
              rej[2], rej[3]], axis=1,
         )                                      # [N, 5, B]
         recvs5 = tuple(sl["recv"] for sl in slots5)   # sinv recv = -1
@@ -824,10 +997,21 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         ob_new = []
         if recv_packed:
             recv_w, recv_off, _ = layout["recv"]
+        rem_fields = (
+            [(f"shr{w}", remaining[w]) for w in range(SW)]
+            if split
+            else [("aux", remaining[0])]
+        )
+        rem_by_word = {}
+        for fname, rw in rem_fields:
+            fw, foff, _ = layout[fname]
+            rem_by_word.setdefault(fw, []).append(
+                rw << foff if foff else rw
+            )
         for w in range(W):
             ws = [words5[k][w] for k in range(_NSLOTS)]
-            if w == aux_w:
-                ws[2] = ws[2] | (remaining << aux_off)
+            for rw in rem_by_word.get(w, ()):
+                ws[2] = ws[2] | rw
             if recv_packed and w == recv_w:
                 # idempotent for merged-deferred rows (their words
                 # already carry the same recv bits)
@@ -860,6 +1044,8 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             "ob_valid": ob_valid_new,
             "tr": s["tr"], "tr_len": s["tr_len"],
         }
+        for w in range(SW if split else 0):
+            out[f"dirs{w}"] = dirsp[w]
         if not recv_packed:
             out["ob_recv"] = ob_recv_new
         for w in range(W):
@@ -879,6 +1065,10 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             ).astype(I32)
             out["snap_cachew"] = jnp.where(s2, cachew, s["snap_cachew"])
             out["snap_dirw"] = jnp.where(s2, dirw, s["snap_dirw"])
+            for w in range(SW if split else 0):
+                out[f"snap_dirs{w}"] = jnp.where(
+                    s2, dirsp[w], s[f"snap_dirs{w}"]
+                )
 
         # ===== counters ==============================================
         row = lambda x: jnp.sum(x.astype(I32), axis=0, keepdims=True)
@@ -987,6 +1177,9 @@ def _init_state(config: SystemConfig, b: int, snapshots: bool = True):
         "scalars": np.zeros((_NSCALAR, b), np.int32),
         "msg_counts": np.zeros((_NTYPES, b), np.int32),
     }
+    split_sw = _sharer_words(config) if _split_mode(config) else 0
+    for w in range(split_sw):
+        state[f"dirs{w}"] = np.zeros((n, m, b), np.int32)
     for w in range(W):
         state[f"mb{w}"] = np.zeros((n, cap, b), np.int32)
         state[f"ob{w}"] = np.zeros((n, _NSLOTS, b), np.int32)
@@ -998,6 +1191,8 @@ def _init_state(config: SystemConfig, b: int, snapshots: bool = True):
             "snap_cachew": cachew0.copy(),
             "snap_dirw": dirw0.copy(),
         })
+        for w in range(split_sw):
+            state[f"snap_dirs{w}"] = np.zeros((n, m, b), np.int32)
     return state
 
 
@@ -1017,7 +1212,8 @@ def _build_call(config: SystemConfig, b: int, bb: int, k: int,
     n, c, m = config.num_procs, config.cache_size, config.mem_size
     cap, nt = config.msg_buffer_size, _NTYPES
     layout, W = _mb_layout(config)
-    fields = _state_fields(W, snapshots, "recv" in layout)
+    split_sw = _sharer_words(config) if _split_mode(config) else 0
+    fields = _state_fields(W, snapshots, "recv" in layout, split_sw)
     outer, inner = -(-k // _GATE), _GATE
 
     shapes = {
@@ -1028,6 +1224,9 @@ def _build_call(config: SystemConfig, b: int, bb: int, k: int,
         "snap_taken": (n,), "snap_cachew": (n, c), "snap_dirw": (n, m),
         "scalars": (_NSCALAR,), "msg_counts": (nt,),
     }
+    for w in range(split_sw):
+        shapes[f"dirs{w}"] = (n, m)
+        shapes[f"snap_dirs{w}"] = (n, m)
     for w in range(W):
         shapes[f"mb{w}"] = (n, cap)
         shapes[f"ob{w}"] = (n, _NSLOTS)
@@ -1303,10 +1502,27 @@ class PallasEngine:
 
     # -- readback -----------------------------------------------------
 
-    def _dump(self, cachew, dirw, sys_idx: int) -> List[NodeDump]:
+    def _dump(self, cachew, dirw, sys_idx: int,
+              dirs=None) -> List[NodeDump]:
         n = self.config.num_procs
-        sh_mask = (1 << n) - 1
+        sh_mask = (1 << min(n, _SPLIT_BPW)) - 1
         addr_mask = (1 << 21) - 1
+
+        def sharers_of(i):
+            if dirs is None:
+                return [
+                    int(x)
+                    for x in (dirw[i, :, sys_idx] >> _DW_SH_SHIFT)
+                    & sh_mask
+                ]
+            return [
+                sum(
+                    int(dirs[w][i, j, sys_idx]) << (w * _SPLIT_BPW)
+                    for w in range(len(dirs))
+                )
+                for j in range(self.config.mem_size)
+            ]
+
         return [
             NodeDump(
                 proc_id=i,
@@ -1315,11 +1531,7 @@ class PallasEngine:
                     int(x)
                     for x in (dirw[i, :, sys_idx] >> _DW_STATE_SHIFT) & 3
                 ],
-                dir_sharers=[
-                    int(x)
-                    for x in (dirw[i, :, sys_idx] >> _DW_SH_SHIFT)
-                    & sh_mask
-                ],
+                dir_sharers=sharers_of(i),
                 cache_addr=[
                     int(x) - 1
                     for x in (cachew[i, :, sys_idx] >> _CW_ADDR_SHIFT)
@@ -1337,6 +1549,14 @@ class PallasEngine:
             for i in range(n)
         ]
 
+    def _split_planes(self, prefix: str):
+        if not _split_mode(self.config):
+            return None
+        return [
+            np.asarray(self.state[f"{prefix}{w}"])
+            for w in range(_sharer_words(self.config))
+        ]
+
     def system_snapshots(self, sys_idx: int) -> List[NodeDump]:
         if not self._snapshots:
             raise ValueError(
@@ -1346,6 +1566,7 @@ class PallasEngine:
             np.asarray(self.state["snap_cachew"]),
             np.asarray(self.state["snap_dirw"]),
             sys_idx,
+            dirs=self._split_planes("snap_dirs"),
         )
 
     def system_final_dumps(self, sys_idx: int) -> List[NodeDump]:
@@ -1353,6 +1574,7 @@ class PallasEngine:
             np.asarray(self.state["cachew"]),
             np.asarray(self.state["dirw"]),
             sys_idx,
+            dirs=self._split_planes("dirs"),
         )
 
     @property

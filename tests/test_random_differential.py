@@ -11,7 +11,8 @@ pins the protocol while the kernels are being tuned for performance
     xla       all (the comparison pivot)
     native    num_procs <= 64; dumps byte-compared via the reference
               (or wide) text format
-    pallas    num_procs <= 21, interpret mode (packed-word path)
+    pallas    interpret mode; packed-word path below 22 nodes,
+              split-plane sharer words beyond (the 33-node row)
 
 Runs under the ``sweep`` marker as part of the default suite.
 """
@@ -57,7 +58,7 @@ GEOMETRIES = [
      12, 10, ("native",)),       # multi-word sharer mask (2 words)
     (SystemConfig(num_procs=33, cache_size=4, mem_size=8,
                   msg_buffer_size=32, semantics=ROBUST),
-     12, 10, ()),                # 2-word mask, xla/spec only
+     12, 10, ("pallas",)),       # 2-word mask; pallas split-plane mode
 ]
 
 assert sum(g[1] for g in GEOMETRIES) >= 200
