@@ -64,12 +64,20 @@ def _build_config(args) -> SystemConfig:
         )
     if args.robust:
         sem = sem.robust()
+    k = getattr(args, "messages_per_cycle", 1)
+    if k != 1 and backend != "spec":
+        raise SystemExit(
+            "--messages-per-cycle > 1 is a spec-engine schedule knob "
+            "(PERF.md lever 4); the other backends drain one message "
+            "per node per cycle"
+        )
     return SystemConfig(
         num_procs=args.nodes,
         cache_size=args.cache_size,
         mem_size=args.mem_size,
         msg_buffer_size=args.msg_buffer_size,
         max_instr_num=args.max_instr,
+        messages_per_cycle=k,
         semantics=sem,
     )
 
@@ -460,6 +468,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="per-core trace cap (reference MAX_INSTR_NUM)",
     )
     p.add_argument("--max-cycles", type=int, default=1_000_000)
+    p.add_argument(
+        "--messages-per-cycle", type=int, default=1,
+        help="lockstep schedule: messages drained per node per cycle "
+        "(spec backend; >1 shortens latency chains on queue-bound "
+        "workloads)",
+    )
     p.add_argument(
         "--robust", action="store_true",
         help="NACK/retry on stale interventions (sound at scale; "

@@ -655,11 +655,19 @@ class SpecEngine:
         progress = False
         handled = [False] * len(self.nodes)
 
-        # 1. handle: one message per node (blocked nodes — those with
-        # deferred sends — stall entirely, like a reference thread
-        # blocked inside sendMessage, assignment.c:715-724)
+        # 1. handle: up to messages_per_cycle messages per node, in
+        # FIFO order (blocked nodes — those with deferred sends —
+        # stall entirely, like a reference thread blocked inside
+        # sendMessage, assignment.c:715-724).  The blocked check is a
+        # cycle-start property: a node's own sends defer only at
+        # end-of-cycle delivery, so they never gate its later drains
+        # within the same cycle.
         for node in self.nodes:
-            if node.mailbox and not node.pending_sends:
+            if node.pending_sends:
+                continue
+            for _ in range(self.config.messages_per_cycle):
+                if not node.mailbox:
+                    break
                 msg = node.mailbox.popleft()
                 if self.trace_msgs:
                     self.msg_log.append(

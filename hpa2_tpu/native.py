@@ -81,6 +81,12 @@ def _check_config(config: SystemConfig) -> None:
             "native backend supports up to 64 nodes (single-word sharer "
             "mask); use the JAX backend beyond"
         )
+    if config.messages_per_cycle != 1:
+        raise NativeError(
+            "the native backend drains one message per node per cycle "
+            "(lockstep) / free-runs (omp); messages_per_cycle > 1 runs "
+            "on the spec engine"
+        )
 
 
 def _sem_flags(config: SystemConfig) -> int:

@@ -271,6 +271,11 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
     _check_geometry(config)
     if sem.overloaded_evict_shared_notify:
         raise ValueError("pallas engine implements fixture semantics only")
+    if config.messages_per_cycle != 1:
+        raise ValueError(
+            "the pallas engine drains one message per node per cycle; "
+            "messages_per_cycle > 1 runs on the spec engine"
+        )
     nack = sem.intervention_miss_policy == "nack"
     layout, W = _mb_layout(config)
     recv_packed = "recv" in layout
@@ -902,19 +907,10 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             return iota_n == sl["recv"][sender][None, :]
 
         def inv_valid(sender):
-            if SW == 1:
-                return ((inv_shw[0][sender][None, :] >> iota_n) & 1) == 1
-            acc_v = zero
-            for w in range(SW):
-                b = iota_n - w * _SPLIT_BPW
-                vw = (
-                    inv_shw[w][sender][None, :]
-                    >> jnp.clip(b, 0, _SPLIT_BPW - 1)
-                ) & 1
-                acc_v = acc_v | jnp.where(
-                    (b >= 0) & (b < _SPLIT_BPW), vw, 0
-                )
-            return acc_v == 1
+            # the same sign-safe per-word bit probe as directory tests
+            return sv_test(
+                [x[sender][None, :] for x in inv_shw], iota_n
+            )
 
         if "deliver" in ablate:
             for k_ in range(_NSLOTS):

@@ -186,6 +186,11 @@ def build_step(
             "overloaded EVICT_SHARED notify (HEAD quirk) is available "
             "in the Python spec engine for differential study"
         )
+    if config.messages_per_cycle != 1:
+        raise ValueError(
+            "the JAX backend drains one message per node per cycle; "
+            "messages_per_cycle > 1 runs on the spec engine"
+        )
     if axis_name is not None:
         if replay:
             raise ValueError(

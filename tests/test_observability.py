@@ -157,3 +157,51 @@ class TestMsgTrace:
         assert len(sends) == res.messages
         assert sorted(sends) == sorted(self.EXPECTED[0::2])
         assert sorted(recvs) == sorted(self.EXPECTED[1::2])
+
+
+@pytest.mark.parametrize("k", [2, 3])
+def test_messages_per_cycle_schedule(k):
+    """The k-messages-per-cycle lockstep schedule (PERF.md lever 4,
+    SystemConfig.messages_per_cycle) on the spec engine: still
+    quiesces, executes the full workload, keeps protocol invariants,
+    and strictly shortens the cycle count vs k=1 on a queue-bound
+    workload."""
+    import dataclasses
+
+    base = SystemConfig(
+        num_procs=8, msg_buffer_size=16, max_instr_num=0,
+        semantics=Semantics().robust(),
+    )
+    cfg_k = dataclasses.replace(base, messages_per_cycle=k)
+    traces = gen_uniform_random(base, 60, seed=11)
+
+    ref = SpecEngine(base, traces)
+    ref.run(max_cycles=50_000)
+    eng = SpecEngine(cfg_k, traces)
+    eng.run(max_cycles=50_000)
+
+    assert eng.instructions == ref.instructions == 8 * 60
+    assert check_invariants(eng.final_dumps(), cfg_k) == []
+    assert eng.cycle < ref.cycle
+
+
+def test_messages_per_cycle_unsupported_engines_guard():
+    """Engines that implement only the reference-shaped k=1 schedule
+    must refuse a k>1 config instead of silently diverging from the
+    spec engine's schedule."""
+    import dataclasses
+
+    from hpa2_tpu import native
+    from hpa2_tpu.ops.step import build_step
+    from hpa2_tpu.ops.pallas_engine import build_cycle
+
+    cfg = dataclasses.replace(
+        SystemConfig(semantics=Semantics().robust()),
+        messages_per_cycle=2,
+    )
+    with pytest.raises(ValueError, match="messages_per_cycle"):
+        build_step(cfg)
+    with pytest.raises(ValueError, match="messages_per_cycle"):
+        build_cycle(cfg, bb=1)
+    with pytest.raises(native.NativeError, match="messages_per_cycle"):
+        native._check_config(cfg)
