@@ -231,7 +231,22 @@ def cmd_bench(args) -> int:
         "local": gen_local_only,
     }[args.workload]
 
-    if args.backend == "omp":
+    if args.backend == "spec":
+        # the executable-spec engine: not a performance path, but the
+        # reference point for schedule experiments
+        # (--messages-per-cycle, PERF.md lever 4)
+        if args.batch > 1:
+            raise SystemExit("the spec backend benchmarks batch 1 only")
+        from hpa2_tpu.models.spec_engine import SpecEngine
+
+        traces = gen(config, args.instrs, seed=args.seed)
+        eng = SpecEngine(config, traces)
+        t0 = time.perf_counter()
+        eng.run(max_cycles=args.max_cycles)
+        dt = time.perf_counter() - t0
+        instrs = eng.instructions
+        print(f"[spec] {eng.cycle} cycles", file=sys.stderr)
+    elif args.backend == "omp":
         if args.workload != "uniform" or args.batch > 1:
             raise SystemExit(
                 "the omp backend benchmarks the uniform workload at "
@@ -533,7 +548,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     bp = sub.add_parser("bench", help="synthetic benchmark, JSON result")
     bp.add_argument(
-        "--backend", choices=("jax", "pallas", "omp"), default="jax"
+        "--backend", choices=("jax", "pallas", "omp", "spec"),
+        default="jax",
     )
     bp.add_argument(
         "--workload",

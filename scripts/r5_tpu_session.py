@@ -1,0 +1,190 @@
+"""One-shot TPU measurement session for round 5.
+
+The axon tunnel has been intermittent (minutes-long windows).  This
+orchestrator runs EVERY pending TPU task in one go, each step in its
+own timeout-guarded subprocess under the cached-compile env, and
+appends one JSON line per step to ``R5_TPU_SESSION.jsonl`` as it
+completes — a dropped tunnel mid-session loses only the running step.
+
+Steps, in value order:
+  1. probe         — is a TPU visible at all?
+  2. bench         — python bench.py (captures BENCH_LAST_TPU.json)
+  3. differential  — scripts/tpu_differential.py (Mosaic-vs-XLA gate)
+  4. sweep512      — current bench shape, full-run wall clock
+  5. block1024     — PERF.md lever 1: window 8, gate off, block 1024
+                     (compile fit was the round-4 blocker)
+  6. sweeps        — a few block/window/gate points around the winner
+  7. scale4/scale5 — BASELINE.json configs 4-5 (scripts/scale_runs.py)
+
+Usage: python scripts/r5_tpu_session.py [--skip probe,bench,...]
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT_PATH = os.path.join(REPO, "R5_TPU_SESSION.jsonl")
+
+
+def _env():
+    from hpa2_tpu import hostenv
+
+    return hostenv.cache_env(dict(os.environ))
+
+
+def record(step, payload):
+    rec = {"step": step,
+           "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    rec.update(payload)
+    with open(OUT_PATH, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def run_py(step, code_or_argv, timeout_s, argv=False):
+    cmd = (
+        [sys.executable] + code_or_argv
+        if argv
+        else [sys.executable, "-c", code_or_argv]
+    )
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd, env=_env(), cwd=REPO, timeout=timeout_s,
+            capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        return record(step, {"ok": False,
+                             "error": f"timeout {timeout_s}s"})
+    out = proc.stdout.decode(errors="replace")
+    last_json = None
+    for line in reversed(out.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                last_json = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    return record(step, {
+        "ok": proc.returncode == 0,
+        "rc": proc.returncode,
+        "wall_s": round(time.time() - t0, 1),
+        "result": last_json,
+        "stderr_tail": proc.stderr.decode(errors="replace")[-400:]
+        if proc.returncode != 0 else "",
+    })
+
+
+def measure_child(params) -> int:
+    """--measure mode: one timed pallas run, one JSON line out.
+    Runs in the child interpreter (under the TPU env)."""
+    import numpy as np
+
+    from hpa2_tpu.config import Semantics, SystemConfig
+    from hpa2_tpu.ops.pallas_engine import PallasEngine, _SC_CYCLE
+    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+    batch, instrs, block, k, cap, window, gate = params
+    config = SystemConfig(num_procs=8, msg_buffer_size=cap,
+                          semantics=Semantics().robust())
+    arrays = gen_uniform_random_arrays(config, batch, instrs, seed=0)
+
+    def build():
+        return PallasEngine(config, *arrays, block=block,
+                            cycles_per_call=k, snapshots=False,
+                            trace_window=window, gate=bool(gate))
+
+    eng = build()
+    t0 = time.perf_counter()
+    eng.run(max_cycles=5_000_000)
+    warm = time.perf_counter() - t0
+    eng2 = build()
+    t0 = time.perf_counter()
+    eng2.run(max_cycles=5_000_000)
+    dt = time.perf_counter() - t0
+    cyc = int(np.max(np.asarray(eng2.state["scalars"][_SC_CYCLE])))
+    print(json.dumps({
+        "batch": batch, "instrs": instrs, "block": block, "k": k,
+        "cap": cap, "window": window, "gate": gate,
+        "instructions": eng2.instructions, "seconds": round(dt, 3),
+        "warm_s": round(warm, 1),
+        "ops_per_sec": round(eng2.instructions / dt, 1),
+        "cycles": cyc,
+        "us_per_cycle": round(dt / max(cyc, 1) * 1e6, 2),
+    }))
+    return 0
+
+
+def measure(step, batch, instrs, block, k, cap, window, gate,
+            timeout_s=900):
+    argv = [os.path.abspath(__file__), "--measure"] + [
+        str(x) for x in (batch, instrs, block, k, cap, window, gate)
+    ]
+    return run_py(step, argv, timeout_s, argv=True)
+
+
+def main() -> int:
+    if sys.argv[1:2] == ["--measure"]:
+        return measure_child([int(x) for x in sys.argv[2:9]])
+    skip = set()
+    for i, a in enumerate(sys.argv):
+        if a == "--skip" and i + 1 < len(sys.argv):
+            skip = set(sys.argv[i + 1].split(","))
+
+    if "probe" not in skip:
+        r = run_py(
+            "probe",
+            "import sys, jax; ds = jax.devices(); "
+            "import json; print(json.dumps({'devices': str(ds)})); "
+            "sys.exit(0 if any('tpu' in str(d).lower() for d in ds) "
+            "else 3)",
+            timeout_s=300,
+        )
+        if not r["ok"]:
+            print("no TPU; aborting session", file=sys.stderr)
+            return 1
+
+    if "bench" not in skip:
+        run_py("bench", [os.path.join(REPO, "bench.py")],
+               timeout_s=1800, argv=True)
+
+    if "differential" not in skip:
+        run_py("differential",
+               [os.path.join(REPO, "scripts", "tpu_differential.py")],
+               timeout_s=900, argv=True)
+
+    if "sweep512" not in skip:
+        # the round-4 shipped shape (block 512, window 32, gate on)
+        measure("sweep512", 32768, 128, 512, 128, 16, 32, 1)
+
+    if "block1024" not in skip:
+        # PERF.md lever 1: 1024 lanes, window 8 (trace plane 1/4),
+        # gate off (no lax.cond carry doubling), k sized to the
+        # per-window cycle need
+        measure("block1024", 32768, 128, 1024, 64, 16, 8, 0)
+
+    if "sweeps" not in skip:
+        measure("sweep_b1024_w16", 32768, 128, 1024, 96, 16, 16, 0)
+        measure("sweep_b1024_gate", 32768, 128, 1024, 64, 16, 8, 1)
+        measure("sweep_b512_w8", 32768, 128, 512, 64, 16, 8, 0)
+        measure("sweep_b2048_w8", 32768, 128, 2048, 64, 16, 8, 0)
+
+    if "scale4" not in skip:
+        run_py("scale4",
+               [os.path.join(REPO, "scripts", "scale_runs.py"), "4"],
+               timeout_s=1800, argv=True)
+    if "scale5" not in skip:
+        run_py("scale5",
+               [os.path.join(REPO, "scripts", "scale_runs.py"), "5"],
+               timeout_s=1800, argv=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
