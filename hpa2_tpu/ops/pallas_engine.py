@@ -885,7 +885,9 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         # accepted-receiver masks per candidate: [slot][sender] -> [N, B]
         acc_masks = [[None] * n for _ in range(_NSLOTS)]
 
-        def candidate(mbs, acc, k, sender, valid_nb):
+        def enqueue(mbs, acc, valid_nb, words_r):
+            """Queue-write core: accept ``valid_nb`` receivers at the
+            current offsets, writing per-receiver word rows."""
             pos = count2 + acc
             accepted = valid_nb & (pos < cap)
             acc_i = accepted.astype(I32)
@@ -893,12 +895,16 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             # no bool-vector broadcast (Mosaic i8->i1 hazard)
             hot = iota_cap == jnp.where(accepted, pos, -1)[:, None, :]
             mbs = [
-                jnp.where(hot, words5[k][w][sender][None, None, :],
-                          mbs[w])
+                jnp.where(hot, words_r[w][:, None, :], mbs[w])
                 for w in range(W)
             ]
+            return mbs, acc + acc_i, accepted, acc_i
+
+        def candidate(mbs, acc, k, sender, valid_nb):
+            words_r = [words5[k][w][sender][None, :] for w in range(W)]
+            mbs, acc, _, acc_i = enqueue(mbs, acc, valid_nb, words_r)
             acc_masks[k][sender] = acc_i
-            return mbs, acc + acc_i
+            return mbs, acc
 
         # the receiver row IS the validity map (-1 = empty slot), so
         # the per-sender check is ONE i32 row broadcast + compare
@@ -917,13 +923,39 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
                 for sender in range(n):
                     acc_masks[k_][sender] = zero
         else:
+            # One message per node per cycle makes a sender's three
+            # phase-A slots RECEIVER-DISJOINT by construction: A1 only
+            # exists for dual-destination FLUSH/FLUSH_INVACK with
+            # second != home (the A0 receiver), and the INV fan comes
+            # only from REPLY_ID, which makes no point sends.
+            # Deferral preserves disjointness (blocked nodes make no
+            # fresh sends).  So the three deliver as ONE candidate —
+            # valid masks OR'd, the word a per-receiver select — which
+            # is order-equivalent to the sequential walk because
+            # disjoint receivers never contend for the same queue
+            # slot.  Delivery drops from 5 to 3 candidates per sender
+            # (measured by jaxpr op count: the unrolled loop was 44%
+            # of the cycle).
             for sender in range(n):
-                mbs, acc = candidate(mbs, acc, 0, sender,
-                                     point_valid(sA0, sender))
-                mbs, acc = candidate(mbs, acc, 1, sender,
-                                     point_valid(sA1, sender))
-                mbs, acc = candidate(mbs, acc, 2, sender,
-                                     inv_valid(sender))
+                vA0 = point_valid(sA0, sender)
+                vA1 = point_valid(sA1, sender)
+                vInv = inv_valid(sender)
+                wsel = [
+                    jnp.where(
+                        vA1, words5[1][w][sender][None, :],
+                        jnp.where(
+                            vInv, words5[2][w][sender][None, :],
+                            words5[0][w][sender][None, :],
+                        ),
+                    )
+                    for w in range(W)
+                ]
+                mbs, acc, accepted, _ = enqueue(
+                    mbs, acc, vA0 | vA1 | vInv, wsel
+                )
+                acc_masks[0][sender] = jnp.where(vA0 & accepted, 1, 0)
+                acc_masks[1][sender] = jnp.where(vA1 & accepted, 1, 0)
+                acc_masks[2][sender] = jnp.where(vInv & accepted, 1, 0)
             for sender in range(n):
                 mbs, acc = candidate(mbs, acc, 3, sender,
                                      point_valid(sB0, sender))

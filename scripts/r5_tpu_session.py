@@ -58,9 +58,18 @@ def run_py(step, code_or_argv, timeout_s, argv=False):
             cmd, env=_env(), cwd=REPO, timeout=timeout_s,
             capture_output=True,
         )
-    except subprocess.TimeoutExpired:
-        return record(step, {"ok": False,
-                             "error": f"timeout {timeout_s}s"})
+    except subprocess.TimeoutExpired as e:
+        # keep whatever the child said before the timeout — the
+        # post-mortem needs to distinguish compile-hang from
+        # device-wait from mid-run drop
+        return record(step, {
+            "ok": False,
+            "error": f"timeout {timeout_s}s",
+            "stdout_tail": (e.stdout or b"").decode(
+                errors="replace")[-400:],
+            "stderr_tail": (e.stderr or b"").decode(
+                errors="replace")[-400:],
+        })
     out = proc.stdout.decode(errors="replace")
     last_json = None
     for line in reversed(out.splitlines()):
@@ -129,6 +138,13 @@ def measure(step, batch, instrs, block, k, cap, window, gate,
     return run_py(step, argv, timeout_s, argv=True)
 
 
+_PROBE_CODE = (
+    "import sys, jax; ds = jax.devices(); "
+    "import json; print(json.dumps({'devices': str(ds)})); "
+    "sys.exit(0 if any('tpu' in str(d).lower() for d in ds) else 3)"
+)
+
+
 def main() -> int:
     if sys.argv[1:2] == ["--measure"]:
         return measure_child([int(x) for x in sys.argv[2:9]])
@@ -138,51 +154,74 @@ def main() -> int:
             skip = set(sys.argv[i + 1].split(","))
 
     if "probe" not in skip:
-        r = run_py(
-            "probe",
-            "import sys, jax; ds = jax.devices(); "
-            "import json; print(json.dumps({'devices': str(ds)})); "
-            "sys.exit(0 if any('tpu' in str(d).lower() for d in ds) "
-            "else 3)",
-            timeout_s=300,
-        )
+        r = run_py("probe", _PROBE_CODE, timeout_s=300)
         if not r["ok"]:
             print("no TPU; aborting session", file=sys.stderr)
             return 1
 
-    if "bench" not in skip:
-        run_py("bench", [os.path.join(REPO, "bench.py")],
-               timeout_s=1800, argv=True)
+    # the tunnel can wedge mid-session (it has, repeatedly): re-probe
+    # cheaply before each expensive step and bail after two
+    # consecutive step failures, so a dropped window costs minutes,
+    # not the sum of every remaining step's timeout
+    state = {"fails": 0}
 
-    if "differential" not in skip:
-        run_py("differential",
-               [os.path.join(REPO, "scripts", "tpu_differential.py")],
-               timeout_s=900, argv=True)
+    def gate(step_name):
+        if state["fails"] >= 2:
+            record(step_name, {"ok": False,
+                               "error": "skipped: session aborted"})
+            return False
+        r = run_py(f"{step_name}.reprobe", _PROBE_CODE, timeout_s=120)
+        if not r["ok"]:
+            state["fails"] = 99
+            record(step_name, {"ok": False,
+                               "error": "skipped: tunnel dropped"})
+            return False
+        return True
 
-    if "sweep512" not in skip:
+    def note(rec):
+        state["fails"] = 0 if rec.get("ok") else state["fails"] + 1
+        return rec
+
+    if "bench" not in skip and gate("bench"):
+        note(run_py("bench", [os.path.join(REPO, "bench.py")],
+                    timeout_s=1800, argv=True))
+
+    if "differential" not in skip and gate("differential"):
+        note(run_py(
+            "differential",
+            [os.path.join(REPO, "scripts", "tpu_differential.py")],
+            timeout_s=900, argv=True))
+
+    if "sweep512" not in skip and gate("sweep512"):
         # the round-4 shipped shape (block 512, window 32, gate on)
-        measure("sweep512", 32768, 128, 512, 128, 16, 32, 1)
+        note(measure("sweep512", 32768, 128, 512, 128, 16, 32, 1))
 
-    if "block1024" not in skip:
+    if "block1024" not in skip and gate("block1024"):
         # PERF.md lever 1: 1024 lanes, window 8 (trace plane 1/4),
         # gate off (no lax.cond carry doubling), k sized to the
         # per-window cycle need
-        measure("block1024", 32768, 128, 1024, 64, 16, 8, 0)
+        note(measure("block1024", 32768, 128, 1024, 64, 16, 8, 0))
 
     if "sweeps" not in skip:
-        measure("sweep_b1024_w16", 32768, 128, 1024, 96, 16, 16, 0)
-        measure("sweep_b1024_gate", 32768, 128, 1024, 64, 16, 8, 1)
-        measure("sweep_b512_w8", 32768, 128, 512, 64, 16, 8, 0)
-        measure("sweep_b2048_w8", 32768, 128, 2048, 64, 16, 8, 0)
+        for nm, params in (
+            ("sweep_b1024_w16", (32768, 128, 1024, 96, 16, 16, 0)),
+            ("sweep_b1024_gate", (32768, 128, 1024, 64, 16, 8, 1)),
+            ("sweep_b512_w8", (32768, 128, 512, 64, 16, 8, 0)),
+            ("sweep_b2048_w8", (32768, 128, 2048, 64, 16, 8, 0)),
+        ):
+            if gate(nm):
+                note(measure(nm, *params))
 
-    if "scale4" not in skip:
-        run_py("scale4",
-               [os.path.join(REPO, "scripts", "scale_runs.py"), "4"],
-               timeout_s=1800, argv=True)
-    if "scale5" not in skip:
-        run_py("scale5",
-               [os.path.join(REPO, "scripts", "scale_runs.py"), "5"],
-               timeout_s=1800, argv=True)
+    if "scale4" not in skip and gate("scale4"):
+        note(run_py(
+            "scale4",
+            [os.path.join(REPO, "scripts", "scale_runs.py"), "4"],
+            timeout_s=1800, argv=True))
+    if "scale5" not in skip and gate("scale5"):
+        note(run_py(
+            "scale5",
+            [os.path.join(REPO, "scripts", "scale_runs.py"), "5"],
+            timeout_s=1800, argv=True))
     return 0
 
 
