@@ -216,12 +216,43 @@ def _state_fields(W: int, snapshots: bool, recv_packed: bool,
     f += [f"mb{w}" for w in range(W)]
     f += ["mb_count", "pc", "waiting", "pending_write"]
     f += [f"ob{w}" for w in range(W)]
-    f += ([] if recv_packed else ["ob_recv"]) + ["ob_valid"]
+    f += [] if recv_packed else ["ob_recv"]
     if snapshots:
         f += ["snap_taken", "snap_cachew", "snap_dirw"]
         f += [f"snap_dirs{w}" for w in range(split_sw)]
     f += ["scalars", "msg_counts"]
     return tuple(f)
+
+
+def deferred_valid(config: SystemConfig, s) -> jnp.ndarray:
+    """[N, 5, ...] i32 validity of the deferred outbox slots, derived
+    from the packed outbox words — there is no ob_valid plane.  Point
+    slots (0, 1, 3, 4) are valid iff their receiver is present (the
+    recv+1 field bits, or the ob_recv plane's non-negative sentinel);
+    the INV slot (2) iff its remainder mask bits are nonzero.  ob_new
+    zeroes non-deferred slots, so the derivation is exact."""
+    layout, W = _mb_layout(config)
+    obw = [s[f"ob{w}"] for w in range(W)]
+
+    def field(name):
+        w, off, wd = layout[name]
+        x = obw[w]
+        if off:
+            x = x >> off
+        if wd < 32:
+            x = x & ((1 << wd) - 1)
+        return x
+
+    point = field("recv") if "recv" in layout else s["ob_recv"] + 1
+    if _split_mode(config):
+        inv = field("shr0")
+        for w_ in range(1, _sharer_words(config)):
+            inv = inv | field(f"shr{w_}")
+    else:
+        inv = field("aux")
+    iota5 = jax.lax.broadcasted_iota(I32, point.shape, 1)
+    sel = jnp.where(iota5 == 2, inv, point)
+    return jnp.where(sel != 0, 1, 0)
 
 
 TRACE_FIELDS = ("tr", "tr_len")
@@ -324,8 +355,10 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         def write_m(arr, idx, mask, val):
             hot = iota_m == jnp.where(mask, idx, -1)[:, None, :]
             return jnp.where(hot, val[:, None, :], arr)
-        # nodes with deferred sends are blocked (no handle, no issue)
-        blocked = jnp.sum(s["ob_valid"], axis=1) > 0        # [N, B]
+        # nodes with deferred sends are blocked (no handle, no issue);
+        # validity is derived from the outbox words themselves
+        dv = deferred_valid(config, s)                      # [N, 5, B]
+        blocked = jnp.sum(dv, axis=1) > 0                   # [N, B]
 
         # ===== phase A: handle one message per node ==================
         has_msg = (s["mb_count"] > 0) & ~blocked
@@ -820,10 +853,8 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         # wire word are harmless: no mailbox decode reads them.  The
         # INV slot stays decoded (its remainder mask must be re-derived
         # each cycle, and its word re-packed clean of the old mask).
-        obv = s["ob_valid"]
-
         def merge_slot(sl, k):
-            pv = obv[:, k, :] != 0
+            pv = dv[:, k, :] != 0
             words = [s[f"ob{w}"][:, k, :] for w in range(W)]
             old_recv = (
                 dec(words, "recv") - 1 if recv_packed
@@ -835,7 +866,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
 
         merge_slot(sA0, 0)
         merge_slot(sA1, 1)
-        pend_inv = obv[:, 2, :] != 0
+        pend_inv = dv[:, 2, :] != 0
         ob2 = [s[f"ob{w}"][:, 2, :] for w in range(W)]
         if split:
             ob2_shw = [dec(ob2, f"shr{w}") for w in range(SW)]
@@ -1015,13 +1046,22 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             )
             for k in (0, 1, 3, 4)
         ]
-        ob_valid_new = jnp.stack(
-            [rej[0], rej[1], (rem_any != 0).astype(I32),
-             rej[2], rej[3]], axis=1,
-        )                                      # [N, 5, B]
+        # per-slot deferral masks: slots 0,1,3,4 defer on rejection;
+        # the INV slot defers iff its remainder mask is nonempty.
+        # NON-deferred slots write a ZERO word (and -1 ob_recv) so the
+        # next cycle's deferred_valid() derivation is exact — this
+        # replaces the ob_valid plane entirely.
+        defer5 = [rej[0], rej[1], (rem_any != 0).astype(I32),
+                  rej[2], rej[3]]
         recvs5 = tuple(sl["recv"] for sl in slots5)   # sinv recv = -1
         if not recv_packed:
-            ob_recv_new = jnp.stack(recvs5, axis=1)
+            ob_recv_new = jnp.stack(
+                [
+                    jnp.where(defer5[k] != 0, recvs5[k], -1)
+                    for k in range(_NSLOTS)
+                ],
+                axis=1,
+            )
         ob_new = []
         if recv_packed:
             recv_w, recv_off, _ = layout["recv"]
@@ -1047,6 +1087,10 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
                     wk | ((recvs5[k] + 1) << recv_off)
                     for k, wk in enumerate(ws)
                 ]
+            ws = [
+                jnp.where(defer5[k] != 0, wk, 0)
+                for k, wk in enumerate(ws)
+            ]
             ob_new.append(jnp.stack(ws, axis=1))
         if "deliver" in ablate:
             # timing fiction, matching the pre-hoist ablation: sends
@@ -1054,9 +1098,12 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             # defer and block issue, and the outbox ops would stay in
             # the ablated graph instead of constant-folding away)
             z5 = jnp.zeros((n, _NSLOTS, bb), I32)
-            ob_valid_new, ob_recv_new = z5, z5
+            ob_recv_new = z5 - 1
             ob_new = [z5 for _ in range(W)]
-        blocked_next = jnp.sum(ob_valid_new, axis=1) > 0
+            defer5 = [zero] * _NSLOTS
+        blocked_next = (
+            defer5[0] + defer5[1] + defer5[2] + defer5[3] + defer5[4]
+        ) > 0
 
         mb_count3 = count2 + acc
         ov_inc = jnp.minimum(
@@ -1069,7 +1116,6 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             "mb_count": mb_count3, "pc": pc,
             "waiting": waiting,
             "pending_write": pending_write,
-            "ob_valid": ob_valid_new,
             "tr": s["tr"], "tr_len": s["tr_len"],
         }
         for w in range(SW if split else 0):
@@ -1111,7 +1157,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
                     keepdims=True)
             + jnp.sum(s["waiting"], axis=0, keepdims=True)
             + jnp.sum(s["mb_count"], axis=0, keepdims=True)
-            + jnp.sum(s["ob_valid"], axis=(0, 1))[None, :]
+            + jnp.sum(dv, axis=(0, 1))[None, :]
         )
         upd = [
             (_SC_CYCLE, jnp.minimum(lane_active, 1)),
@@ -1137,17 +1183,6 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         return out
 
     return cycle
-
-
-def quiescent_block(s) -> jnp.ndarray:
-    """[B] bool: per-system quiescence in transposed layout (host-side
-    readback; the in-kernel check is the integer form in ``body``)."""
-    return (
-        jnp.all(s["pc"] >= s["tr_len"], axis=0)
-        & jnp.all(s["waiting"] == 0, axis=0)
-        & jnp.all(s["mb_count"] == 0, axis=0)
-        & jnp.all(s["ob_valid"] == 0, axis=(0, 1))
-    )
 
 
 # ---------------------------------------------------------------------------
@@ -1201,7 +1236,6 @@ def _init_state(config: SystemConfig, b: int, snapshots: bool = True):
         "dirw": dirw0,
         "mb_count": z2.copy(), "pc": z2.copy(),
         "waiting": z2.copy(), "pending_write": z2.copy(),
-        "ob_valid": np.zeros((n, _NSLOTS, b), np.int32),
         "scalars": np.zeros((_NSCALAR, b), np.int32),
         "msg_counts": np.zeros((_NTYPES, b), np.int32),
     }
@@ -1212,7 +1246,8 @@ def _init_state(config: SystemConfig, b: int, snapshots: bool = True):
         state[f"mb{w}"] = np.zeros((n, cap, b), np.int32)
         state[f"ob{w}"] = np.zeros((n, _NSLOTS, b), np.int32)
     if "recv" not in layout:
-        state["ob_recv"] = np.zeros((n, _NSLOTS, b), np.int32)
+        # -1 = empty (deferred_valid's point-slot sentinel)
+        state["ob_recv"] = np.full((n, _NSLOTS, b), -1, np.int32)
     if snapshots:
         state.update({
             "snap_taken": z2.copy(),
@@ -1248,7 +1283,7 @@ def _build_call(config: SystemConfig, b: int, bb: int, k: int,
         "cachew": (n, c), "dirw": (n, m),
         "mb_count": (n,), "pc": (n,),
         "waiting": (n,), "pending_write": (n,),
-        "ob_recv": (n, _NSLOTS), "ob_valid": (n, _NSLOTS),
+        "ob_recv": (n, _NSLOTS),
         "snap_taken": (n,), "snap_cachew": (n, c), "snap_dirw": (n, m),
         "scalars": (_NSCALAR,), "msg_counts": (nt,),
     }
@@ -1284,7 +1319,7 @@ def _build_call(config: SystemConfig, b: int, bb: int, k: int,
                 jnp.sum(jnp.maximum(st["tr_len"] - st["pc"], 0))
                 + jnp.sum(st["waiting"])
                 + jnp.sum(st["mb_count"])
-                + jnp.sum(st["ob_valid"])
+                + jnp.sum(deferred_valid(config, st))
             )
             return jax.lax.cond(active == 0, lambda x: x, run_gate, st)
 
@@ -1358,7 +1393,7 @@ def _build_run(config: SystemConfig, b: int, bb: int, k: int,
             jnp.all(st["pc"] >= tl)
             & jnp.all(st["waiting"] == 0)
             & jnp.all(st["mb_count"] == 0)
-            & jnp.all(st["ob_valid"] == 0)
+            & jnp.all(deferred_valid(config, st) == 0)
         )
 
     def run_all(state, tr_full, tr_len_full):

@@ -170,7 +170,9 @@ def main() -> int:
             record(step_name, {"ok": False,
                                "error": "skipped: session aborted"})
             return False
-        r = run_py(f"{step_name}.reprobe", _PROBE_CODE, timeout_s=120)
+        # same budget as the initial probe: a reprobe re-initializes
+        # the full TPU client, which can legitimately take minutes
+        r = run_py(f"{step_name}.reprobe", _PROBE_CODE, timeout_s=300)
         if not r["ok"]:
             state["fails"] = 99
             record(step_name, {"ok": False,

@@ -6,6 +6,8 @@ Runs in Pallas interpreter mode (CPU); the kernel path is exercised on
 real TPU by bench.py.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -145,3 +147,32 @@ def test_pallas_run_idempotent_and_not_resumable():
     before = pe.instructions
     pe.run()  # no-op: counters must not double
     assert pe.instructions == before
+
+
+@pytest.mark.skipif(
+    not os.environ.get("HPA2_SLOW"),
+    reason="~5 min in interpret mode; set HPA2_SLOW=1 to run",
+)
+def test_split_plane_64_nodes_sw3():
+    """Three sharer words (SW=3) on the split-plane path: 64 nodes, a
+    geometry the native backend also caps at (single-word 64-bit mask)
+    and the reference's 1-byte bitVector cannot express at all.  The
+    33-node sweep row covers SW=2 every run; this pins the >2-word
+    generality of the sv_* helpers on demand."""
+    from hpa2_tpu.models.spec_engine import SpecEngine
+
+    cfg = SystemConfig(num_procs=64, cache_size=2, mem_size=4,
+                       msg_buffer_size=16,
+                       semantics=Semantics().robust())
+    op, addr, val, length = gen_uniform_random_arrays(cfg, 2, 6, seed=9)
+    pe = PallasEngine(cfg, op, addr, val, length, block=2,
+                      cycles_per_call=32, interpret=True)
+    pe.run(max_cycles=100_000)
+    for b in range(2):
+        spec = SpecEngine(
+            cfg, _traces_from_arrays(op, addr, val, b, 64)
+        )
+        spec.run(max_cycles=50_000)
+        assert _dicts(pe.system_final_dumps(b)) == _dicts(
+            spec.final_dumps()
+        ), f"b={b}"
