@@ -1,0 +1,278 @@
+"""Bounded liveness model check of the rebuilt protocol — driving the
+REAL SpecEngine handlers (not a re-model) under EVERY free-running
+interleaving of message handling and instruction issue.
+
+The reference's free-running execution can interleave a node's
+instruction issue with any message arrival order; its drop-policy
+intervention handling livelocks on some of those interleavings
+(SURVEY.md §6.3: a WRITEBACK_* reaching an owner that already evicted
+is silently dropped, leaving the requester waiting forever).  The
+lockstep engines sidestep the interleavings but the PROTOCOL claim at
+scale is stronger: with the NACK policy, every reachable state can
+still reach quiescence.  This checker proves that claim exhaustively
+for bounded configurations by:
+
+  * exploring the full state graph (BFS, memoized on frozen engine
+    state) where an enabled action is either "node i handles its
+    mailbox head" or "node i issues its next instruction" (enabled
+    when its mailbox is empty and it is not waiting — the reference's
+    drain-all-then-issue loop shape, assignment.c:153-699);
+  * instant per-receiver-FIFO delivery in emission order (capacity
+    backpressure is a separate mechanism, pinned by
+    tests/test_backpressure.py; an unbounded mailbox isolates
+    protocol livelock from capacity deadlock);
+  * asserting, under Semantics().robust():  (a) every terminal state
+    (no enabled action) is quiescent — deadlock freedom; (b) from
+    EVERY reachable state a quiescent state remains reachable —
+    livelock freedom (EF quiescent everywhere);
+  * asserting, under the parity default drop policy, that DOOMED
+    states exist for the stale-eviction workload and every one of
+    them shows the documented signature (some node waiting forever) —
+    the reference's unsoundness, reproduced exhaustively rather than
+    by sampled fuzzing.
+
+The exploration is exact, not sampled: a state-count cap guards
+against blowup, and the test FAILS if the cap is hit (a truncated
+exploration proves nothing).
+"""
+
+import copy
+
+import pytest
+
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.models.protocol import Instr, Message, MsgType
+from hpa2_tpu.models.spec_engine import SpecEngine
+
+STATE_CAP = 400_000
+
+
+class _Model(SpecEngine):
+    """SpecEngine with instant delivery: sends append straight to the
+    receiver's FIFO in emission order (free-running semantics)."""
+
+    def _send(self, phase, receiver, msg):  # noqa: ARG002
+        self.nodes[receiver].mailbox.append(msg)
+
+
+def _freeze(eng):
+    return tuple(
+        (
+            tuple((l.address, l.value, int(l.state)) for l in n.cache),
+            tuple(n.memory),
+            tuple((int(d.state), d.sharers) for d in n.directory),
+            n.waiting,
+            n.pending_write,
+            n.pc,
+            tuple(
+                (int(m.type), m.sender, m.address, m.value, m.sharers,
+                 m.second_receiver)
+                for m in n.mailbox
+            ),
+        )
+        for n in eng.nodes
+    )
+
+
+def _thaw(config, traces, frozen):
+    eng = _Model(config, traces)
+    for n, fr in zip(eng.nodes, frozen):
+        lines, mem, directory, waiting, pw, pc, box = fr
+        for line, (a, v, s) in zip(n.cache, lines):
+            line.address, line.value, line.state = a, v, s
+        n.memory = list(mem)
+        for d, (ds, sh) in zip(n.directory, directory):
+            d.state, d.sharers = ds, sh
+        n.waiting = waiting
+        n.pending_write = pw
+        n.pc = pc
+        n.mailbox.clear()
+        for t, snd, addr, val, sh, second in box:
+            n.mailbox.append(
+                Message(MsgType(t), snd, addr, val, sh, second)
+            )
+    return eng
+
+
+def _enabled(eng):
+    acts = []
+    for n in eng.nodes:
+        if n.mailbox:
+            acts.append(("handle", n.id))
+        elif not n.waiting and n.pc < len(n.trace):
+            acts.append(("issue", n.id))
+    return acts
+
+
+def _apply(eng, act):
+    kind, i = act
+    node = eng.nodes[i]
+    if kind == "handle":
+        eng._handle(node, node.mailbox.popleft())
+    else:
+        eng._issue(node)
+
+
+def _is_quiescent(frozen, traces):
+    return all(
+        fr[5] >= len(traces[i]) and not fr[3] and not fr[6]
+        for i, fr in enumerate(frozen)
+    )
+
+
+def _explore(config, traces):
+    """Full reachable state graph.  Returns (states, edges, quiescent,
+    terminal_nonquiescent)."""
+    init = _freeze(_Model(config, traces))
+    index = {init: 0}
+    states = [init]
+    edges = []            # (src, dst)
+    quiescent = set()
+    stuck = set()
+    frontier = [0]
+    while frontier:
+        nxt = []
+        for si in frontier:
+            fr = states[si]
+            eng = _thaw(config, traces, fr)
+            acts = _enabled(eng)
+            if not acts:
+                if _is_quiescent(fr, traces):
+                    quiescent.add(si)
+                else:
+                    stuck.add(si)
+                continue
+            for act in acts:
+                # the last action can mutate the thawed engine in place
+                child = eng if act is acts[-1] else copy.deepcopy(eng)
+                _apply(child, act)
+                cf = _freeze(child)
+                ci = index.get(cf)
+                if ci is None:
+                    ci = len(states)
+                    index[cf] = ci
+                    states.append(cf)
+                    nxt.append(ci)
+                    assert len(states) <= STATE_CAP, (
+                        "state cap hit — exploration would be "
+                        "truncated, result meaningless"
+                    )
+                edges.append((si, ci))
+        frontier = nxt
+    return states, edges, quiescent, stuck
+
+
+def _can_reach(n_states, edges, targets):
+    """Reverse reachability: which states can reach ``targets``."""
+    rev = [[] for _ in range(n_states)]
+    for s, d in edges:
+        rev[d].append(s)
+    seen = set(targets)
+    stack = list(targets)
+    while stack:
+        x = stack.pop()
+        for p in rev[x]:
+            if p not in seen:
+                seen.add(p)
+                stack.append(p)
+    return seen
+
+
+def _mk(policy, traces_for):
+    sem = Semantics().robust() if policy == "nack" else Semantics()
+    config = SystemConfig(
+        num_procs=3, cache_size=1, mem_size=2, msg_buffer_size=64,
+        max_instr_num=0, semantics=sem,
+    )
+    return config, traces_for(config)
+
+
+def _stale_eviction_traces(config):
+    """SURVEY.md §6.3's hang class: P1 gains ownership of address 0
+    then evicts it (cache_size=1 collision with address 2, a different
+    home) while P2's read races the eviction."""
+    del config
+    return [
+        [],
+        [Instr("W", 0, 7), Instr("R", 2)],
+        [Instr("R", 0)],
+    ]
+
+
+def _sharing_traces(config):
+    """Read sharing + upgrade + last-sharer notify traffic on one hot
+    block, home node 0 itself a sharer."""
+    del config
+    return [
+        [Instr("R", 0)],
+        [Instr("R", 0), Instr("W", 0, 9)],
+        [Instr("R", 0), Instr("R", 2)],
+    ]
+
+
+def _heavier_traces(config):
+    """Writes, upgrades, evictions and re-reads interleaved on two
+    colliding addresses — ~36K reachable states, the largest bounded
+    configuration the suite proves exhaustively (~11s)."""
+    del config
+    return [
+        [Instr("R", 0), Instr("W", 0, 5)],
+        [Instr("R", 0), Instr("W", 0, 9), Instr("R", 2)],
+        [Instr("R", 0), Instr("R", 2), Instr("R", 0)],
+    ]
+
+
+@pytest.mark.parametrize(
+    "traces_for",
+    [_stale_eviction_traces, _sharing_traces, _heavier_traces],
+)
+def test_robust_protocol_livelock_free(traces_for):
+    config, traces = _mk("nack", traces_for)
+    states, edges, quiescent, stuck = _explore(config, traces)
+    assert not stuck, (
+        f"deadlock: {len(stuck)} terminal non-quiescent states"
+    )
+    assert quiescent, "no quiescent state reachable at all"
+    ok = _can_reach(len(states), edges, quiescent)
+    doomed = set(range(len(states))) - ok
+    assert not doomed, (
+        f"livelock: {len(doomed)}/{len(states)} reachable states "
+        "cannot reach quiescence under the NACK policy"
+    )
+
+
+@pytest.mark.parametrize(
+    "traces_for", [_stale_eviction_traces, _sharing_traces]
+)
+def test_drop_policy_has_doomed_states(traces_for):
+    """The parity-default drop policy (the reference's semantics) IS
+    unsound under free-running interleavings: the checker must find
+    doomed states (15 on the stale-eviction workload, 169 on the
+    sharing workload — including true terminal deadlocks), and each
+    shows the documented signature — a node waiting for a reply that
+    can no longer arrive (SURVEY.md §6.3 root defect (b))."""
+    config, traces = _mk("drop", traces_for)
+    states, edges, quiescent, stuck = _explore(config, traces)
+    ok = _can_reach(len(states), edges, quiescent) if quiescent else set()
+    doomed = set(range(len(states))) - ok
+    assert doomed, (
+        "expected the drop policy to be unsound on this workload; if "
+        "this starts passing the protocol semantics changed — update "
+        "SURVEY.md §6.3"
+    )
+    # both workloads also reach TERMINAL deadlocks (waiting node, all
+    # mailboxes empty) — the claim README makes, asserted so it cannot
+    # silently rot
+    assert stuck, "expected terminal non-quiescent states under drop"
+    assert stuck <= doomed
+    for si in doomed:
+        fr = states[si]
+        waiting_somewhere = any(f[3] for f in fr) or any(
+            f[6] for f in fr
+        )
+        assert waiting_somewhere, (
+            f"doomed state {si} without a waiting node or in-flight "
+            "message — not the documented livelock signature"
+        )
+
+
