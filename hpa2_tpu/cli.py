@@ -168,7 +168,23 @@ def cmd_run(args) -> int:
                 "its deterministic schedule is identical to the spec "
                 "engine's, so record there)"
             )
-        if args.node_shards > 1:
+        if args.backend == "pallas":
+            # the TPU fast path on a single system (batch 1; Mosaic
+            # on TPU, interpret elsewhere) — same dumps as the others
+            if replay is not None:
+                raise SystemExit(
+                    "--replay runs on the spec/jax/omp lockstep "
+                    "engines (the pallas kernel has no replay mode)"
+                )
+            from hpa2_tpu.ops.pallas_engine import PallasEngine
+            from hpa2_tpu.utils.trace import traces_to_arrays
+
+            eng = PallasEngine(
+                config, *traces_to_arrays(config, [traces]),
+                snapshots=not args.final_dump,
+            )
+            eng.run(args.max_cycles)
+        elif args.node_shards > 1:
             # multi-chip: shard the simulated-node axis over the mesh
             # (cross-shard delivery = one ICI all_gather per cycle);
             # bit-identical to the single-chip engine
@@ -522,7 +538,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     rp = sub.add_parser("run", help="run a trace directory, write dumps")
     rp.add_argument("trace_dir")
     rp.add_argument(
-        "--backend", choices=("spec", "jax", "omp"), default="jax"
+        "--backend", choices=("spec", "jax", "omp", "pallas"),
+        default="jax",
     )
     rp.add_argument("--out", help="output directory (default: CWD)")
     rp.add_argument(

@@ -1640,9 +1640,37 @@ class PallasEngine:
             dirs=self._split_planes("dirs"),
         )
 
+    # single-system aliases matching the other engines' interface
+    # (the CLI `run --backend pallas` path)
+
+    def snapshots(self) -> List[NodeDump]:
+        if self.b != 1:
+            raise ValueError(
+                "snapshots() is the batch-1 interface; use "
+                "system_snapshots(b) on ensembles"
+            )
+        return self.system_snapshots(0)
+
+    def final_dumps(self) -> List[NodeDump]:
+        if self.b != 1:
+            raise ValueError(
+                "final_dumps() is the batch-1 interface; use "
+                "system_final_dumps(b) on ensembles"
+            )
+        return self.system_final_dumps(0)
+
     @property
     def instructions(self) -> int:
         return int(np.sum(np.asarray(self.state["scalars"][_SC_INSTR])))
+
+    @property
+    def messages(self) -> int:
+        return int(np.sum(np.asarray(self.state["scalars"][_SC_MSGS])))
+
+    @property
+    def cycle(self) -> int:
+        """Max per-system cycle count (lockstep wall cycles)."""
+        return int(np.max(np.asarray(self.state["scalars"][_SC_CYCLE])))
 
     def stats(self) -> dict:
         from hpa2_tpu.ops.engine import format_stats

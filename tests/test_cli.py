@@ -9,7 +9,7 @@ import pytest
 from hpa2_tpu.cli import main
 
 
-@pytest.mark.parametrize("backend", ["spec", "jax"])
+@pytest.mark.parametrize("backend", ["spec", "jax", "pallas"])
 def test_run_matches_fixtures(tmp_path, backend, reference_tests_dir):
     rc = main([
         "run", str(reference_tests_dir / "test_1"),

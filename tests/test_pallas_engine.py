@@ -176,3 +176,24 @@ def test_split_plane_64_nodes_sw3():
         assert _dicts(pe.system_final_dumps(b)) == _dicts(
             spec.final_dumps()
         ), f"b={b}"
+
+
+@pytest.mark.parametrize("suite", ["sample", "test_1", "test_2"])
+def test_pallas_deterministic_fixture_parity(reference_tests_dir, suite):
+    """The fourth backend runs the reference corpus too: byte-exact
+    dump-at-local-completion parity on the deterministic suites (the
+    CLI exposes this as `run --backend pallas`)."""
+    from hpa2_tpu.utils.dump import format_processor_state
+    from hpa2_tpu.utils.trace import load_trace_dir, traces_to_arrays
+
+    cfg = SystemConfig()
+    traces = load_trace_dir(str(reference_tests_dir / suite), cfg)
+    eng = PallasEngine(cfg, *traces_to_arrays(cfg, [traces]))
+    eng.run(100_000)
+    for nd in eng.system_snapshots(0):
+        want = (
+            reference_tests_dir / suite / f"core_{nd.proc_id}_output.txt"
+        ).read_text()
+        assert format_processor_state(nd, cfg) == want, (
+            f"{suite} core_{nd.proc_id}"
+        )
