@@ -1,30 +1,30 @@
 """On-TPU differential: the Mosaic Pallas kernel vs the XLA engine on
-the same random workload.  Exit 0 + JSON on agreement."""
+the same random workloads — the reference 8-node geometry AND a
+33-node split-plane geometry (two sharer words), so the wide-node path
+is validated under the real Mosaic lowering, not just the interpreter.
+Exit 0 + one JSON line on agreement."""
 
 import json
+import os
 import sys
 
 sys.path.insert(0, "/root/repo")
 
 
-def main() -> int:
+def _compare(tag, config, batch, instrs, seed):
     import numpy as np
     import jax.numpy as jnp
 
-    from hpa2_tpu.config import Semantics, SystemConfig
     from hpa2_tpu.ops.engine import build_batched_run
     from hpa2_tpu.ops.pallas_engine import PallasEngine
     from hpa2_tpu.ops.state import init_state_batched
     from hpa2_tpu.utils.trace import gen_uniform_random_arrays
 
-    config = SystemConfig(
-        num_procs=8, msg_buffer_size=32, semantics=Semantics().robust()
-    )
-    batch, instrs = 128, 24
-    arrays = gen_uniform_random_arrays(config, batch, instrs, seed=7)
+    arrays = gen_uniform_random_arrays(config, batch, instrs, seed=seed)
 
     eng = PallasEngine(config, *arrays)
-    assert not eng._interpret_active, "expected Mosaic path on TPU"
+    if not os.environ.get("HPA2_ALLOW_INTERPRET"):
+        assert not eng._interpret_active, "expected Mosaic path on TPU"
     eng.run()
 
     state = init_state_batched(config, *arrays)
@@ -33,10 +33,18 @@ def main() -> int:
 
     mem = np.asarray(out.mem)
     dstate = np.asarray(out.dir_state)
-    dsh = np.asarray(out.dir_sharers)[:, :, :, 0]
+    # [B, N, M, W] uint32 words -> true python-int masks
+    dshw = np.asarray(out.dir_sharers).astype(np.uint32)
     caddr = np.asarray(out.cache_addr)
     cval = np.asarray(out.cache_val)
     cstate = np.asarray(out.cache_state)
+
+    def xla_sharers(b, i):
+        return [
+            sum(int(dshw[b, i, j, k]) << (32 * k)
+                for k in range(dshw.shape[3]))
+            for j in range(config.mem_size)
+        ]
 
     mism = 0
     for b in range(batch):
@@ -45,7 +53,7 @@ def main() -> int:
             okv = (
                 nd.memory == [int(x) for x in mem[b, i]]
                 and nd.dir_state == [int(x) for x in dstate[b, i]]
-                and nd.dir_sharers == [int(x) for x in dsh[b, i]]
+                and nd.dir_sharers == xla_sharers(b, i)
                 and nd.cache_addr == [int(x) for x in caddr[b, i]]
                 and nd.cache_value == [int(x) for x in cval[b, i]]
                 and nd.cache_state == [int(x) for x in cstate[b, i]]
@@ -53,10 +61,36 @@ def main() -> int:
             mism += 0 if okv else 1
     xi = int(jnp.sum(out.n_instr))
     pi = eng.instructions
-    ok = mism == 0 and xi == pi
-    print(json.dumps({"ok": ok, "node_mismatches": mism,
-                      "instr_xla": xi, "instr_pallas": pi,
-                      "batch": batch}))
+    return {
+        "tag": tag, "ok": mism == 0 and xi == pi,
+        # self-describing: an interpret-mode run (HPA2_ALLOW_INTERPRET
+        # escape hatch) must never read as a Mosaic validation
+        "interpret": bool(eng._interpret_active),
+        "node_mismatches": mism, "instr_xla": xi, "instr_pallas": pi,
+        "batch": batch,
+    }
+
+
+def main() -> int:
+    from hpa2_tpu.config import Semantics, SystemConfig
+
+    robust = Semantics().robust()
+    results = [
+        _compare(
+            "8n-packed",
+            SystemConfig(num_procs=8, msg_buffer_size=32,
+                         semantics=robust),
+            128, 24, 7,
+        ),
+        _compare(
+            "33n-split",
+            SystemConfig(num_procs=33, cache_size=4, mem_size=8,
+                         msg_buffer_size=32, semantics=robust),
+            16, 10, 11,
+        ),
+    ]
+    ok = all(r["ok"] for r in results)
+    print(json.dumps({"ok": ok, "geometries": results}))
     return 0 if ok else 1
 
 
