@@ -136,11 +136,11 @@ def bench_jax(config, batch, instrs_per_core, seed=0):
     return instrs, dt
 
 
-def bench_omp(config, instrs_per_core, seed=0):
+def bench_omp(config, instrs_per_core, seed=0, mode="omp"):
     from hpa2_tpu import native
 
     res = native.bench_random(
-        config, instrs_per_core=instrs_per_core, seed=seed, mode="omp"
+        config, instrs_per_core=instrs_per_core, seed=seed, mode=mode
     )
     return int(res.instructions), float(res.seconds)
 
@@ -196,6 +196,20 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
         )
     except Exception as e:  # baseline unavailable: report jax-only
         result["note"] = f"omp baseline failed: {e}"
+    try:
+        # context: the deterministic single-threaded native engine —
+        # on small hosts it beats thread-per-node by an order of
+        # magnitude (lock thrash under oversubscription), so the
+        # free-running baseline's host sensitivity is visible in the
+        # artifact
+        ls_instrs, ls_dt = bench_omp(
+            config, instrs_per_core=50_000, mode="lockstep"
+        )
+        result["native_lockstep_ops_per_sec"] = round(
+            ls_instrs / ls_dt, 1
+        )
+    except Exception as e:  # optional context only — never fatal
+        result["native_lockstep_note"] = f"lockstep context failed: {e}"
     print(json.dumps(result))
     return 0
 
