@@ -126,7 +126,32 @@ def test_native_free_run_local_traffic_round_trips_exact(tmp_path):
         assert got == format_processor_state(dump, CFG), f"core_{i}"
 
 
-def test_native_free_run_cross_traffic_replay_validates(tmp_path):
+def _head_value_quirks_robust():
+    import dataclasses
+
+    return dataclasses.replace(
+        Semantics().robust(),
+        eager_write_request_memory=True,
+        flush_invack_fills_old_value=True,
+    )
+
+
+@pytest.mark.parametrize(
+    "sem_factory,count,seed",
+    [
+        # fixture semantics + NACK (the plain cross-traffic loop)
+        (lambda: Semantics().robust(), 20, 4),
+        # the HEAD-differential workflow under concurrency: both value
+        # quirks active on the free-running native side AND the spec
+        # replay side, so quirk semantics survive record -> replay ->
+        # verify, not just lockstep runs
+        (_head_value_quirks_robust, 16, 6),
+    ],
+    ids=["fixture-robust", "head-value-quirks"],
+)
+def test_native_free_run_cross_traffic_replay_validates(
+    tmp_path, sem_factory, count, seed
+):
     """threads=4 free run with cross-node traffic: the recorded order
     must be a valid interleaving, replay must complete with the full
     instruction count, and the free dumps sit inside (or near) the
@@ -135,42 +160,55 @@ def test_native_free_run_cross_traffic_replay_validates(tmp_path):
     reference's own test_4/run_1 fixture is proven unreachable)."""
     from hpa2_tpu import native
 
+    cfg = SystemConfig(num_procs=4, semantics=sem_factory())
     native.ensure_built()
-    traces = gen_uniform_random(CFG, 20, seed=4)
+    traces = gen_uniform_random(cfg, count, seed=seed)
     tdir = tmp_path / "tr"
     _write_traces(traces, str(tdir))
-    out = tmp_path / "out"
-    out.mkdir()
-    orderp = tmp_path / "order.txt"
-    res = native.run_trace_dir(
-        CFG, str(tdir), str(out), mode="omp",
-        record_order_path=str(orderp), threads=4,
-    )
-    assert res.ok
-    order = load_instruction_order(str(orderp))
-    assert len(order) == sum(len(t) for t in traces)
-    validate_order_against_traces(order, traces)
 
-    best_matches = 0
-    for batched in (True, False):
-        rep = SpecEngine(
-            CFG, traces, replay_order=order, replay_batched=batched
+    # The soundness properties (valid interleaving, full replay) are
+    # HARD on every attempt.  The envelope match is statistical — an
+    # OS-scheduled free run occasionally lands outside every replay
+    # dump candidate (message order is underdetermined; the
+    # reference's own test_4/run_1 fixture is proven unreachable) —
+    # so it gets a few fresh interleavings before failing.
+    for attempt in range(3):
+        out = tmp_path / f"out_{attempt}"
+        out.mkdir()
+        orderp = tmp_path / f"order_{attempt}.txt"
+        res = native.run_trace_dir(
+            cfg, str(tdir), str(out), mode="omp",
+            record_order_path=str(orderp), threads=4,
         )
-        rep.run(100_000)
-        assert rep.instructions == len(order)
-        matches = 0
-        for i in range(CFG.num_procs):
-            free_dump = (out / f"core_{i}_output.txt").read_text()
-            cands = [
-                format_processor_state(d, CFG)
-                for d in rep.nodes[i].dump_candidates
-            ]
-            matches += free_dump in cands
-        best_matches = max(best_matches, matches)
-    assert best_matches >= 1, (
-        "no node of the free run matched any replay dump candidate — "
-        "the recorded order no longer corresponds to the execution"
-    )
+        assert res.ok
+        order = load_instruction_order(str(orderp))
+        assert len(order) == sum(len(t) for t in traces)
+        validate_order_against_traces(order, traces)
+
+        best_matches = 0
+        for batched in (True, False):
+            rep = SpecEngine(
+                cfg, traces, replay_order=order, replay_batched=batched
+            )
+            rep.run(100_000)
+            assert rep.instructions == len(order)
+            matches = 0
+            for i in range(cfg.num_procs):
+                free_dump = (out / f"core_{i}_output.txt").read_text()
+                cands = [
+                    format_processor_state(d, cfg)
+                    for d in rep.nodes[i].dump_candidates
+                ]
+                matches += free_dump in cands
+            best_matches = max(best_matches, matches)
+        if best_matches >= 1:
+            break
+    else:
+        raise AssertionError(
+            "no node of any free run matched a replay dump candidate "
+            "across 3 interleavings — the recorded order no longer "
+            "corresponds to the execution"
+        )
 
 
 def test_cli_record_and_replay_round_trip(tmp_path, reference_tests_dir):
