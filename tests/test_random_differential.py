@@ -182,6 +182,14 @@ def _sweep(cfg, batch, extra, arrays, tmp_path, allow_stall):
         assert stalled > 0, (
             "pallas reported a batch stall but no spec system stalled"
         )
+    elif pe is not None:
+        # stall agreement is two-way: a quiesced pallas batch means
+        # NO spec system may have stalled (the batch status scalar
+        # ORs every system's liveness)
+        assert stalled == 0, (
+            f"{stalled} spec systems stalled but the pallas batch "
+            "quiesced"
+        )
 
 
 @pytest.mark.sweep
