@@ -45,6 +45,26 @@ _LAST_TPU_PATH = os.path.join(_REPO_ROOT, "BENCH_LAST_TPU.json")
 # bench workload shape (see child_main)
 _TPU_BATCH, _TPU_INSTRS = 32768, 128
 _BLOCK, _CAP, _WINDOW, _K = 512, 16, 32, 128
+_GATE = True
+# measurement sessions (scripts/r5_tpu_session.py) write the best
+# swept kernel shape here so the next bench run uses it without a
+# code edit; absent/invalid -> the defaults above
+_TUNING_PATH = os.path.join(_REPO_ROOT, "BENCH_TUNING.json")
+
+
+def _tuned_shape():
+    block, window, k, gate = _BLOCK, _WINDOW, _K, _GATE
+    try:
+        with open(_TUNING_PATH) as f:
+            t = json.load(f)
+        block = int(t.get("block", block))
+        window = int(t.get("window", window))
+        k = int(t.get("k", k))
+        gate = bool(t.get("gate", gate))
+    except Exception:  # noqa: BLE001 - ANY malformed tuning file must
+        # degrade to the known-good defaults, never crash the bench
+        return _BLOCK, _WINDOW, _K, _GATE
+    return block, window, k, gate
 
 
 def _bench_config():
@@ -69,12 +89,14 @@ def compile_gate_main() -> int:
     from hpa2_tpu.utils.trace import gen_uniform_random_arrays
 
     config = _bench_config()
-    arrays = gen_uniform_random_arrays(config, 1024, 16, seed=0)
+    block, _, _, gate = _tuned_shape()
+    arrays = gen_uniform_random_arrays(config, max(block, 1024), 16,
+                                       seed=0)
     t0 = time.time()
     try:
-        eng = PallasEngine(config, *arrays, block=_BLOCK,
+        eng = PallasEngine(config, *arrays, block=block,
                            cycles_per_call=8, interpret=False,
-                           snapshots=False)
+                           snapshots=False, gate=gate)
         eng._call.lower(eng.state, eng.traces).compile()
     except Exception as e:  # noqa: BLE001 - reported upward as data
         print(json.dumps({"ok": False, "error": str(e)[-400:]}))
@@ -90,11 +112,12 @@ def bench_pallas(config, batch, instrs_per_core, seed=0):
 
     arrays = gen_uniform_random_arrays(config, batch, instrs_per_core,
                                        seed=seed)
+    block, window, k, gate = _tuned_shape()
 
     def build():
-        return PallasEngine(config, *arrays, block=_BLOCK,
-                            cycles_per_call=_K, snapshots=False,
-                            trace_window=_WINDOW)
+        return PallasEngine(config, *arrays, block=block,
+                            cycles_per_call=k, snapshots=False,
+                            trace_window=window, gate=gate)
 
     build().run()  # compile + warmup
     eng = build()
@@ -185,6 +208,11 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
     }
     if engine != "pallas":
         result["pallas_error"] = err
+    else:
+        block, window, k, gate = _tuned_shape()
+        result["kernel_shape"] = {
+            "block": block, "window": window, "k": k, "gate": gate,
+        }
     try:
         omp_instrs, omp_dt = bench_omp(config, instrs_per_core=50_000)
         omp_ops = omp_instrs / omp_dt
