@@ -33,8 +33,12 @@ import time
 _REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 # escalating probe budget: the axon tunnel's cold start has been seen
 # to need minutes (the dryrun budget is 480s); 90s x2 was too brittle
-# (BENCH_r04: both probes timed out while the same-day dryrun passed)
+# (BENCH_r04: both probes timed out while the same-day dryrun passed).
+# When a last-good TPU record exists the ladder is shorter — a
+# tunnel-down round then reports the dated stale number instead of
+# gambling the caller's whole time budget on a third long probe.
 _PROBE_TIMEOUTS_S = (90, 180, 480)
+_PROBE_TIMEOUTS_WITH_FALLBACK_S = (90, 240)
 _COMPILE_GATE_TIMEOUT_S = 240
 _TPU_CHILD_TIMEOUT_S = 540
 _CPU_CHILD_TIMEOUT_S = 300
@@ -263,7 +267,12 @@ def _probe_tpu() -> bool:
         "import sys, jax; ds = jax.devices(); "
         "sys.exit(0 if any('tpu' in str(d).lower() for d in ds) else 3)"
     )
-    for attempt, budget in enumerate(_PROBE_TIMEOUTS_S):
+    ladder = (
+        _PROBE_TIMEOUTS_WITH_FALLBACK_S
+        if _load_last_tpu() is not None
+        else _PROBE_TIMEOUTS_S
+    )
+    for attempt, budget in enumerate(ladder):
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", code],
