@@ -239,6 +239,17 @@ def test_robust_protocol_livelock_free(traces_for):
         f"livelock: {len(doomed)}/{len(states)} reachable states "
         "cannot reach quiescence under the NACK policy"
     )
+    # safety at every reachable end state: the protocol invariants
+    # (single writer, EM/S sharer-set shape, S-value coherence) hold
+    # in each quiescent state of the exploration
+    from hpa2_tpu.utils.invariants import check_invariants
+
+    for si in quiescent:
+        eng = _thaw(config, traces, states[si])
+        violations = check_invariants(
+            [n.dump() for n in eng.nodes], config
+        )
+        assert violations == [], f"quiescent state {si}: {violations}"
 
 
 @pytest.mark.parametrize(
