@@ -25,7 +25,7 @@ import sys
 import time
 from typing import List, Optional
 
-from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.config import FaultModel, Semantics, SystemConfig
 
 
 _QUIRK_FIELDS = {
@@ -71,6 +71,36 @@ def _build_config(args) -> SystemConfig:
             "(PERF.md lever 4); the other backends drain one message "
             "per node per cycle"
         )
+    edge_sender = edge_receiver = -1
+    if args.fault_edge:
+        try:
+            s, r = args.fault_edge.split(":")
+            edge_sender, edge_receiver = int(s), int(r)
+        except ValueError:
+            raise SystemExit(
+                "--fault-edge takes SENDER:RECEIVER (node ids, -1 = any)"
+            )
+    fault = FaultModel(
+        drop=args.fault_drop,
+        duplicate=args.fault_dup,
+        reorder=args.fault_reorder,
+        delay=args.fault_delay,
+        seed=args.fault_seed,
+        max_retries=args.fault_max_retries,
+        edge_sender=edge_sender,
+        edge_receiver=edge_receiver,
+    )
+    if fault.enabled and backend in ("pallas", "omp"):
+        raise SystemExit(
+            "fault injection is implemented by the spec and jax "
+            "backends (the pallas kernel and the native engine have "
+            "no link-layer fault model)"
+        )
+    if fault.enabled and getattr(args, "node_shards", 1) > 1:
+        raise SystemExit(
+            "fault injection is single-shard only (the link-layer "
+            "PRNG stream is per-system, not per-shard)"
+        )
     return SystemConfig(
         num_procs=args.nodes,
         cache_size=args.cache_size,
@@ -79,6 +109,7 @@ def _build_config(args) -> SystemConfig:
         max_instr_num=args.max_instr,
         messages_per_cycle=k,
         semantics=sem,
+        fault=fault,
     )
 
 
@@ -106,6 +137,11 @@ def _check_shard_args(args) -> None:
 def cmd_run(args) -> int:
     config = _build_config(args)
     _check_shard_args(args)
+    if (args.crash_at or args.resume) and args.backend != "spec":
+        raise SystemExit(
+            "--crash-at/--resume checkpoint the spec engine's Python "
+            "state (the jax bench path has its own --checkpoint-every)"
+        )
     if args.data_shards > 1:
         raise SystemExit(
             "--data-shards applies to bench (--batch > 1 ensembles); "
@@ -137,16 +173,45 @@ def cmd_run(args) -> int:
 
     from hpa2_tpu.utils.trace import load_instruction_order, load_trace_dir
 
-    traces = load_trace_dir(args.trace_dir, config)
+    # a --resume checkpoint carries its own traces; trace_dir is unused
+    traces = None if args.resume else load_trace_dir(args.trace_dir, config)
     replay = load_instruction_order(args.replay) if args.replay else None
 
     t0 = time.perf_counter()
     if args.backend == "spec":
         from hpa2_tpu.models.spec_engine import SpecEngine
+        from hpa2_tpu.utils.checkpoint import (
+            load_spec_state,
+            save_spec_state,
+        )
 
-        eng = SpecEngine(config, traces, replay_order=replay,
-                         trace_msgs=bool(args.trace_msgs))
-        eng.run(max_cycles=args.max_cycles)
+        if args.resume:
+            eng = load_spec_state(args.resume)
+            config = eng.config  # the checkpoint's config wins
+            print(
+                f"resumed from {args.resume} at cycle {eng.cycle}",
+                file=sys.stderr,
+            )
+        else:
+            eng = SpecEngine(config, traces, replay_order=replay,
+                             trace_msgs=bool(args.trace_msgs))
+        if args.crash_at:
+            # simulate a mid-run crash: advance to the cycle, persist,
+            # exit.  A later --resume run finishes byte-identically.
+            while eng.cycle < args.crash_at and not (
+                eng.quiescent() and all(n.dumped for n in eng.nodes)
+            ):
+                eng.step()
+            path = args.crash_checkpoint
+            save_spec_state(path, eng)
+            print(
+                f"checkpointed at cycle {eng.cycle} -> {path} "
+                "(resume with --resume)",
+                file=sys.stderr,
+            )
+            return 0
+        eng.run(max_cycles=args.max_cycles,
+                watchdog_cycles=args.watchdog_cycles)
         if args.trace_msgs:
             with open(args.trace_msgs, "w") as f:
                 f.writelines(line + "\n" for line in eng.msg_log)
@@ -212,6 +277,7 @@ def cmd_run(args) -> int:
             eng = JaxEngine(
                 config, traces, replay_order=replay,
                 max_cycles=args.max_cycles,
+                watchdog_cycles=args.watchdog_cycles,
             )
             eng.run()
     dt = time.perf_counter() - t0
@@ -258,7 +324,8 @@ def cmd_bench(args) -> int:
         traces = gen(config, args.instrs, seed=args.seed)
         eng = SpecEngine(config, traces)
         t0 = time.perf_counter()
-        eng.run(max_cycles=args.max_cycles)
+        eng.run(max_cycles=args.max_cycles,
+                watchdog_cycles=args.watchdog_cycles)
         dt = time.perf_counter() - t0
         instrs = eng.instructions
         print(f"[spec] {eng.cycle} cycles", file=sys.stderr)
@@ -452,8 +519,10 @@ def cmd_bench(args) -> int:
         from hpa2_tpu.ops.engine import JaxEngine
 
         traces = gen(config, args.instrs, seed=args.seed)
-        JaxEngine(config, traces, max_cycles=args.max_cycles).run()
-        eng = JaxEngine(config, traces, max_cycles=args.max_cycles)
+        JaxEngine(config, traces, max_cycles=args.max_cycles,
+                  watchdog_cycles=args.watchdog_cycles).run()
+        eng = JaxEngine(config, traces, max_cycles=args.max_cycles,
+                        watchdog_cycles=args.watchdog_cycles)
         t0 = time.perf_counter()
         eng.run()
         dt = time.perf_counter() - t0
@@ -526,6 +595,49 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="omp backend: thread-per-node free-running mode like the "
         "reference (nondeterministic interleavings)",
     )
+    fg = p.add_argument_group(
+        "fault injection (spec/jax backends; faults are masked by "
+        "link-layer retry — dumps stay byte-identical to a fault-free "
+        "run unless a link is fully severed)"
+    )
+    fg.add_argument(
+        "--fault-drop", type=float, default=0.0, metavar="P",
+        help="per-hop drop probability (each dropped copy is "
+        "retransmitted in-cycle, up to --fault-max-retries)",
+    )
+    fg.add_argument(
+        "--fault-dup", type=float, default=0.0, metavar="P",
+        help="per-delivery duplicate probability (duplicates are "
+        "filtered by sequence number; counted in stats)",
+    )
+    fg.add_argument(
+        "--fault-reorder", type=float, default=0.0, metavar="P",
+        help="per-delivery reorder probability (reassembled back to "
+        "FIFO order at the receiver; counted in stats)",
+    )
+    fg.add_argument(
+        "--fault-delay", type=float, default=0.0, metavar="P",
+        help="per-delivery extra-latency probability (absorbed within "
+        "the delivery cycle; counted in stats)",
+    )
+    fg.add_argument("--fault-seed", type=int, default=0)
+    fg.add_argument(
+        "--fault-max-retries", type=int, default=64,
+        help="in-cycle retransmission budget per message; exhaustion "
+        "defers the send to the next cycle (backpressure path)",
+    )
+    fg.add_argument(
+        "--fault-edge", default="", metavar="S:R",
+        help="restrict faults to the directed link S->R (-1 = any); "
+        "e.g. --fault-drop 1.0 --fault-edge 1:0 severs one link to "
+        "exercise the watchdog",
+    )
+    p.add_argument(
+        "--watchdog-cycles", type=int, default=10_000, metavar="K",
+        help="raise a structured StallDiagnostic when no instruction "
+        "retires and no mailbox drains for K cycles (0 disables); "
+        "spec and jax backends",
+    )
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -559,6 +671,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     rp.add_argument(
         "--final-dump", action="store_true",
         help="dump final quiescent state instead of at local completion",
+    )
+    rp.add_argument(
+        "--crash-at", type=int, default=0, metavar="CYCLE",
+        help="spec backend: simulate a crash — advance to CYCLE, "
+        "write --crash-checkpoint, exit (no dumps)",
+    )
+    rp.add_argument(
+        "--crash-checkpoint", default="hpa2_spec_ckpt.json",
+        metavar="PATH",
+        help="where --crash-at persists the engine state (JSON)",
+    )
+    rp.add_argument(
+        "--resume", default=None, metavar="PATH",
+        help="spec backend: resume from a --crash-at checkpoint and "
+        "finish the run (byte-identical to an uninterrupted run, "
+        "fault stream included; trace_dir is ignored)",
     )
     _add_common(rp)
     rp.set_defaults(fn=cmd_run)

@@ -50,3 +50,30 @@ def forced_cpu_env(
 def has_device_count_flag(env: Optional[dict] = None) -> bool:
     source = os.environ if env is None else env
     return _DEVICE_COUNT_FLAG in source.get("XLA_FLAGS", "")
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_replication=False):
+    """Version-compatible ``shard_map`` (jax is imported lazily so this
+    module stays safe to import before backend init).
+
+    The API moved twice across the JAX releases this repo meets:
+    ``jax.experimental.shard_map.shard_map`` (kwarg ``check_rep``) is
+    the only spelling in older installs, while newer ones promote it to
+    ``jax.shard_map`` (kwarg ``check_vma``) and deprecate — then remove
+    — the experimental path.  Resolve the public name first so the
+    deprecated import is never touched when the modern one exists.
+    """
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_replication,
+        )
+    from jax.experimental.shard_map import shard_map as fn
+
+    return fn(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_replication,
+    )

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import random
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 from hpa2_tpu.config import Semantics, SystemConfig
@@ -60,7 +61,7 @@ from hpa2_tpu.models.protocol import (
     is_bit_set,
 )
 from hpa2_tpu.utils.dump import NodeDump
-from hpa2_tpu.utils.trace import IssueRecord
+from hpa2_tpu.utils.trace import IssueRecord, TraceRing
 
 
 @dataclasses.dataclass
@@ -137,6 +138,59 @@ class StallError(RuntimeError):
     or an unachievable replay order)."""
 
 
+class StallDiagnostic(StallError):
+    """Structured stall/watchdog diagnostic.
+
+    A ``StallError`` subclass (every existing ``except StallError``
+    keeps working) carrying the machine-readable state a livelock
+    post-mortem needs: per-node mailbox depth, waiting/send-blocked
+    sets, cache-line states, the recent-delivery flight recorder, an
+    advisory mid-flight invariant check, and the engine counters.
+    """
+
+    def __init__(
+        self,
+        reason: str,
+        cycle: int,
+        mailbox_depths: Dict[int, int],
+        waiting: List[int],
+        blocked: List[int],
+        line_states: Dict[int, List[str]],
+        recent_msgs: List[str],
+        invariant_violations: List[str],
+        counters: Dict[str, int],
+    ):
+        self.reason = reason
+        self.cycle = cycle
+        self.mailbox_depths = mailbox_depths
+        self.waiting = waiting
+        self.blocked = blocked
+        self.line_states = line_states
+        self.recent_msgs = recent_msgs
+        self.invariant_violations = invariant_violations
+        self.counters = counters
+        super().__init__(self._render())
+
+    def _render(self) -> str:
+        out = [
+            f"{self.reason} (cycle {self.cycle})",
+            f"  waiting nodes: {self.waiting}; "
+            f"send-blocked nodes: {self.blocked}",
+            "  mailbox depths: "
+            + ", ".join(f"{n}:{d}" for n, d in self.mailbox_depths.items()),
+        ]
+        for node, lines in self.line_states.items():
+            if lines:
+                out.append(f"  node {node} cache: " + ", ".join(lines))
+        if self.recent_msgs:
+            out.append(f"  last {len(self.recent_msgs)} deliveries:")
+            out.extend("    " + m for m in self.recent_msgs)
+        if self.invariant_violations:
+            out.append("  invariant check (mid-flight subset):")
+            out.extend("    " + m for m in self.invariant_violations)
+        return "\n".join(out)
+
+
 class SpecEngine:
     def __init__(
         self,
@@ -145,6 +199,7 @@ class SpecEngine:
         replay_order: Optional[Sequence[IssueRecord]] = None,
         replay_batched: bool = False,
         trace_msgs: bool = False,
+        debug_invariants: bool = False,
     ):
         if len(traces) != config.num_procs:
             raise ValueError("need one trace per node")
@@ -174,6 +229,18 @@ class SpecEngine:
         # dequeue
         self.trace_msgs = trace_msgs
         self.msg_log: List[str] = []
+        # link-layer fault injection (None when all rates are 0: the
+        # fault-free hot path stays draw-free and branch-free)
+        self._fault_rng: Optional[random.Random] = (
+            random.Random(config.fault.seed) if config.fault.enabled else None
+        )
+        # watchdog bookkeeping: last cycle that retired an instruction
+        # or drained a mailbox, plus the delivery flight recorder
+        self.last_activity_cycle = 0
+        self.recent_msgs = TraceRing()
+        # per-step mid-flight invariant checking (debug aid; O(N*M)
+        # per cycle, keep off in sweeps)
+        self.debug_invariants = debug_invariants
 
     @property
     def instructions(self) -> int:
@@ -192,6 +259,40 @@ class SpecEngine:
         self.counters["msgs_total"] += 1
         self._outbox.append((phase, msg.sender, receiver, msg))
 
+    def _wire(self, sender: int, receiver: int) -> bool:
+        """Simulate one message crossing the faulty link (link-layer
+        reliable transport: seq/ack with in-cycle retransmission).
+
+        Drops are retried with fresh randomness up to
+        ``fault.max_retries`` rounds; duplicate, reorder and delay
+        events are absorbed by the receiver's link layer (dup filter,
+        reassembly window, skew buffer) and surface only as counters.
+        Returns True once a copy gets through; False when the retry
+        budget is exhausted — the caller then defers the message to
+        the sender's pending queue and the link retries next cycle.
+        """
+        fm = self.config.fault
+        if not fm.applies(sender, receiver):
+            return True
+        rng = self._fault_rng
+        rounds = 0
+        while rng.random() < fm.drop:
+            rounds += 1
+            if rounds >= fm.max_retries:
+                self.counters["fault_drops"] += rounds
+                self.counters["fault_link_stalls"] += 1
+                return False
+        if rounds:
+            self.counters["fault_drops"] += rounds
+            self.counters["fault_retransmissions"] += rounds
+        if fm.duplicate > 0.0 and rng.random() < fm.duplicate:
+            self.counters["fault_dups_filtered"] += 1
+        if fm.reorder > 0.0 and rng.random() < fm.reorder:
+            self.counters["fault_reorders_fixed"] += 1
+        if fm.delay > 0.0 and rng.random() < fm.delay:
+            self.counters["fault_delays"] += 1
+        return True
+
     def _deliver(self) -> bool:
         """End-of-cycle delivery with capacity backpressure.
 
@@ -200,9 +301,12 @@ class SpecEngine:
         their original positions, this cycle's new sends at theirs (a
         node never has both: blocked nodes don't act).  A candidate is
         accepted iff its receiver's mailbox has a free slot at that
-        point of the walk; rejected candidates become (stay) the
-        sender's pending_sends, preserving order.  Returns True if any
-        message was delivered (progress).
+        point of the walk AND it crosses the (possibly faulty) link
+        within the retry budget; rejected candidates become (stay) the
+        sender's pending_sends, preserving order.  Once an edge stalls
+        this cycle, every later candidate on the same (sender,
+        receiver) edge defers too, keeping per-edge FIFO exact.
+        Returns True if any message was delivered (progress).
         """
         cap = self.config.msg_buffer_size
         merged: List[Tuple[int, int, int, Message]] = []
@@ -214,11 +318,25 @@ class SpecEngine:
         self._outbox.clear()
         merged.sort(key=lambda t: (t[0], t[1]))  # stable
         delivered_any = False
+        fault_on = self._fault_rng is not None
+        stalled_edges = set()
         for ph, sender, receiver, msg in merged:
             box = self.nodes[receiver].mailbox
-            if len(box) < cap:
+            ok = len(box) < cap
+            if ok and fault_on:
+                edge = (sender, receiver)
+                if edge in stalled_edges:
+                    ok = False
+                elif not self._wire(sender, receiver):
+                    stalled_edges.add(edge)
+                    ok = False
+            if ok:
                 box.append(msg)
                 delivered_any = True
+                self.recent_msgs.record(
+                    self.cycle, msg.sender, receiver,
+                    int(msg.type), msg.address,
+                )
                 if self.trace_msgs:
                     self.msg_log.append(
                         f"Processor {msg.sender} sent msg to: "
@@ -653,6 +771,7 @@ class SpecEngine:
     def step(self) -> bool:
         """Run one global cycle.  Returns True if any progress was made."""
         progress = False
+        active = False  # watchdog progress: retired instr / drained msg
         handled = [False] * len(self.nodes)
 
         # 1. handle: up to messages_per_cycle messages per node, in
@@ -678,6 +797,7 @@ class SpecEngine:
                 self._handle(node, msg)
                 handled[node.id] = True
                 progress = True
+                active = True
 
         # 2. issue
         if self.replay_order is not None:
@@ -704,6 +824,7 @@ class SpecEngine:
                 issued.add(node.id)
                 self.order_pos += 1
                 progress = True
+                active = True
                 if not self.replay_batched:
                     break
         else:
@@ -716,6 +837,7 @@ class SpecEngine:
                 ):
                     self._issue(node)
                     progress = True
+                    active = True
 
         # 3. deliver (capacity backpressure; delivering a previously
         # deferred send is progress even in an otherwise idle cycle)
@@ -741,6 +863,18 @@ class SpecEngine:
                 elif handled[node.id]:
                     node.dump_candidates.append(node.dump())
 
+        if active:
+            self.last_activity_cycle = self.cycle
+        if self.debug_invariants:
+            from hpa2_tpu.utils.invariants import check_invariants
+
+            bad = check_invariants(
+                [n.dump() for n in self.nodes], self.config, mid_flight=True
+            )
+            if bad:
+                raise self.stall_diagnostic(
+                    "mid-flight invariant violation"
+                )
         self.cycle += 1
         return progress
 
@@ -753,23 +887,74 @@ class SpecEngine:
             for n in self.nodes
         ) and (self.replay_order is None or self.order_pos >= len(self.replay_order))
 
-    def run(self, max_cycles: int = 10_000_000) -> None:
+    def stall_diagnostic(self, reason: str) -> StallDiagnostic:
+        """Snapshot the structured post-mortem for a stalled system."""
+        from hpa2_tpu.utils.invariants import check_invariants
+
+        line_states: Dict[int, List[str]] = {}
+        for n in self.nodes:
+            lines = []
+            for idx, ln in enumerate(n.cache):
+                if ln.address == INVALID_ADDR:
+                    continue
+                lines.append(
+                    f"[{idx}] 0x{ln.address:02X}="
+                    f"{CacheState(ln.state).name}({ln.value})"
+                )
+            line_states[n.id] = lines
+        return StallDiagnostic(
+            reason=reason,
+            cycle=self.cycle,
+            mailbox_depths={n.id: len(n.mailbox) for n in self.nodes},
+            waiting=[n.id for n in self.nodes if n.waiting],
+            blocked=[n.id for n in self.nodes if n.pending_sends],
+            line_states=line_states,
+            recent_msgs=self.recent_msgs.lines(),
+            invariant_violations=check_invariants(
+                self.final_dumps(), self.config, mid_flight=True
+            ),
+            counters=dict(self.counters),
+        )
+
+    def run(
+        self,
+        max_cycles: int = 10_000_000,
+        watchdog_cycles: int = 10_000,
+    ) -> None:
+        """Run to quiescence.
+
+        Two stall detectors guard the loop.  The fast detector fires
+        after 3 consecutive zero-progress cycles — sound when the
+        transport is reliable, because an idle non-quiescent system
+        can never move again.  Under fault injection a zero-progress
+        cycle still retries stalled links with fresh randomness, so
+        the fast detector is replaced by the watchdog: no instruction
+        retired AND no mailbox drained for ``watchdog_cycles``
+        consecutive cycles (0 disables it).  Both raise a structured
+        :class:`StallDiagnostic` instead of spinning to ``max_cycles``.
+        """
         stall = 0
+        fault_on = self._fault_rng is not None
         while not (self.quiescent() and all(n.dumped for n in self.nodes)):
             progress = self.step()
             if self.cycle >= max_cycles:
                 raise StallError(f"no quiescence after {max_cycles} cycles")
+            if (
+                watchdog_cycles
+                and self.cycle - self.last_activity_cycle >= watchdog_cycles
+            ):
+                raise self.stall_diagnostic(
+                    "watchdog: no instruction retired and no mailbox "
+                    f"drained for {watchdog_cycles} cycles"
+                )
             if not progress:
                 stall += 1
-                if stall > 2:
-                    waiting = [n.id for n in self.nodes if n.waiting]
-                    blocked = [n.id for n in self.nodes if n.pending_sends]
-                    raise StallError(
-                        f"livelock at cycle {self.cycle}: waiting nodes "
-                        f"{waiting}, send-blocked nodes {blocked} "
-                        "(stale intervention dropped? cyclic full "
-                        "mailboxes? use Semantics.intervention_miss_"
-                        "policy='nack' / a larger msg_buffer_size)"
+                if stall > 2 and not fault_on:
+                    raise self.stall_diagnostic(
+                        f"livelock at cycle {self.cycle}: stale "
+                        "intervention dropped? cyclic full mailboxes? "
+                        "use Semantics.intervention_miss_policy='nack' "
+                        "/ a larger msg_buffer_size"
                     )
             else:
                 stall = 0

@@ -23,10 +23,16 @@ import jax
 import jax.numpy as jnp
 
 from hpa2_tpu.config import SystemConfig
-from hpa2_tpu.models.protocol import Instr
-from hpa2_tpu.models.spec_engine import StallError
+from hpa2_tpu.models.protocol import CacheState, Instr, MsgType
+from hpa2_tpu.models.spec_engine import StallDiagnostic, StallError
 from hpa2_tpu.ops import bits
-from hpa2_tpu.ops.state import SimState, init_state
+from hpa2_tpu.ops.state import (
+    MB_ADDR,
+    MB_SENDER,
+    MB_TYPE,
+    SimState,
+    init_state,
+)
 from hpa2_tpu.ops.step import (
     build_run,
     build_step,
@@ -57,9 +63,11 @@ class JaxEngine:
         traces: Sequence[Sequence[Instr]],
         replay_order: Optional[Sequence[IssueRecord]] = None,
         max_cycles: int = 1_000_000,
+        watchdog_cycles: int = 10_000,
     ):
         self.config = config
         self.max_cycles = max_cycles
+        self.watchdog_cycles = watchdog_cycles
         self.replay = replay_order is not None
         if self.replay:
             # fail fast like the spec engine instead of simulating a
@@ -68,7 +76,10 @@ class JaxEngine:
 
             validate_order_against_traces(replay_order, traces)
         self.state: SimState = init_state(config, traces, replay_order)
-        self._run = build_run(config, replay=self.replay, max_cycles=max_cycles)
+        self._run = build_run(
+            config, replay=self.replay, max_cycles=max_cycles,
+            watchdog_cycles=watchdog_cycles,
+        )
         self.dump_candidates: List[List[NodeDump]] = [
             [] for _ in range(config.num_procs)
         ]
@@ -92,11 +103,77 @@ class JaxEngine:
                 "despite backpressure (engine bug)"
             )
         if not bool(quiescent(st)):
+            cycle = int(st.cycle)
+            stalled_for = cycle - int(st.last_progress)
+            if (
+                self.watchdog_cycles
+                and cycle < self.max_cycles
+                and stalled_for >= self.watchdog_cycles
+            ):
+                raise self._stall_diagnostic(
+                    "watchdog: no instruction retired and no mailbox "
+                    f"drained for {stalled_for} cycles"
+                )
             raise StallError(
-                f"no quiescence after {int(st.cycle)} cycles "
+                f"no quiescence after {cycle} cycles "
                 "(livelock: stale intervention dropped? use "
                 "Semantics.intervention_miss_policy='nack')"
             )
+
+    def _stall_diagnostic(self, reason: str) -> StallDiagnostic:
+        """Structured post-mortem from the device state (mirrors
+        SpecEngine.stall_diagnostic; the JAX engine has no host-side
+        flight recorder, so "recent" messages are the still-queued
+        mailbox heads — exactly the traffic the stall left in
+        flight)."""
+        from hpa2_tpu.utils.invariants import check_invariants
+
+        st = self.state
+        cfg = self.config
+        n = cfg.num_procs
+        mb_count = np.asarray(st.mb_count)
+        waiting = np.asarray(st.waiting)
+        blocked = np.any(np.asarray(st.ob_valid), axis=1)
+        caddr = np.asarray(st.cache_addr)
+        cval = np.asarray(st.cache_val)
+        cstate = np.asarray(st.cache_state)
+        line_states = {}
+        for i in range(n):
+            lines = []
+            for idx in range(cfg.cache_size):
+                a = int(caddr[i, idx])
+                if a == -1:
+                    continue
+                lines.append(
+                    f"[{idx}] 0x{a:02X}="
+                    f"{CacheState(int(cstate[i, idx])).name}"
+                    f"({int(cval[i, idx])})"
+                )
+            line_states[i] = lines
+        mb_data = np.asarray(st.mb_data)
+        queued = []
+        for i in range(n):
+            for s_i in range(min(int(mb_count[i]), 4)):
+                row = mb_data[i, s_i]
+                queued.append(
+                    f"queued at node {i}[{s_i}]: from "
+                    f"{int(row[MB_SENDER])} "
+                    f"{MsgType(int(row[MB_TYPE])).name} "
+                    f"0x{int(row[MB_ADDR]):02X}"
+                )
+        return StallDiagnostic(
+            reason=reason,
+            cycle=int(st.cycle),
+            mailbox_depths={i: int(mb_count[i]) for i in range(n)},
+            waiting=[i for i in range(n) if waiting[i]],
+            blocked=[i for i in range(n) if blocked[i]],
+            line_states=line_states,
+            recent_msgs=queued,
+            invariant_violations=check_invariants(
+                self.final_dumps(), cfg, mid_flight=True
+            ),
+            counters=self.stats(),
+        )
 
     # -- parity path: per-cycle stepping with candidate capture -------
 
@@ -206,19 +283,29 @@ def engine_stats(st: SimState) -> dict:
     if mc.ndim == 2:  # batched state: aggregate over the ensemble
         mc = mc.sum(axis=0)
     tot = lambda x: int(np.sum(np.asarray(x)))
-    return format_stats(
-        {
-            "instructions": tot(st.n_instr),
-            "msgs_total": tot(st.n_msgs),
-            "read_hits": tot(st.n_read_hits),
-            "read_misses": tot(st.n_read_miss),
-            "write_hits": tot(st.n_write_hits),
-            "write_misses": tot(st.n_write_miss),
-            "evictions": tot(st.n_evictions),
-            "invalidations": tot(st.n_invalidations),
-        },
-        mc,
-    )
+    core = {
+        "instructions": tot(st.n_instr),
+        "msgs_total": tot(st.n_msgs),
+        "read_hits": tot(st.n_read_hits),
+        "read_misses": tot(st.n_read_miss),
+        "write_hits": tot(st.n_write_hits),
+        "write_misses": tot(st.n_write_miss),
+        "evictions": tot(st.n_evictions),
+        "invalidations": tot(st.n_invalidations),
+    }
+    # fault-layer counters: present only when nonzero, so fault-free
+    # counter parity with the spec engine is key-for-key exact
+    for name, field in (
+        ("fault_retransmissions", st.n_retrans),
+        ("fault_dups_filtered", st.n_dup_filtered),
+        ("fault_reorders_fixed", st.n_reorder_fixed),
+        ("fault_delays", st.n_delays),
+        ("fault_link_stalls", st.n_wire_stalls),
+    ):
+        val = tot(field)
+        if val:
+            core[name] = val
+    return format_stats(core, mc)
 
 
 # ---------------------------------------------------------------------------

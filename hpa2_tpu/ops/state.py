@@ -16,6 +16,7 @@ from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from hpa2_tpu.config import SystemConfig
@@ -113,6 +114,15 @@ class SimState(NamedTuple):
     n_evictions: jnp.ndarray
     n_invalidations: jnp.ndarray
     msg_counts: jnp.ndarray  # [len(MsgType)] sends by transaction type
+    # link-layer fault injection + watchdog bookkeeping (scalars;
+    # rng_key is a raw uint32[2] PRNG key, split once per cycle)
+    rng_key: jnp.ndarray        # [2] uint32
+    last_progress: jnp.ndarray  # last cycle that retired/drained
+    n_retrans: jnp.ndarray      # link retransmission rounds
+    n_dup_filtered: jnp.ndarray
+    n_reorder_fixed: jnp.ndarray
+    n_delays: jnp.ndarray
+    n_wire_stalls: jnp.ndarray  # retry budget exhausted -> deferred
 
 
 def init_state_batched(
@@ -191,6 +201,15 @@ def init_state_batched(
         n_evictions=zeros((b,), I32),
         n_invalidations=zeros((b,), I32),
         msg_counts=zeros((b, len(MsgType)), I32),
+        rng_key=jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            jax.random.PRNGKey(config.fault.seed), jnp.arange(b)
+        ),
+        last_progress=zeros((b,), I32),
+        n_retrans=zeros((b,), I32),
+        n_dup_filtered=zeros((b,), I32),
+        n_reorder_fixed=zeros((b,), I32),
+        n_delays=zeros((b,), I32),
+        n_wire_stalls=zeros((b,), I32),
     )
 
 
@@ -282,4 +301,11 @@ def init_state(
         n_evictions=jnp.zeros((), dtype=I32),
         n_invalidations=jnp.zeros((), dtype=I32),
         msg_counts=jnp.zeros((len(MsgType),), dtype=I32),
+        rng_key=jax.random.PRNGKey(config.fault.seed),
+        last_progress=jnp.zeros((), dtype=I32),
+        n_retrans=jnp.zeros((), dtype=I32),
+        n_dup_filtered=jnp.zeros((), dtype=I32),
+        n_reorder_fixed=jnp.zeros((), dtype=I32),
+        n_delays=jnp.zeros((), dtype=I32),
+        n_wire_stalls=jnp.zeros((), dtype=I32),
     )

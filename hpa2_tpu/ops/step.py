@@ -202,6 +202,14 @@ def build_step(
                 f"num_procs={n} not divisible by shards={shards}"
             )
     nack = sem.intervention_miss_policy == "nack"
+    fault = config.fault
+    fault_on = fault.enabled  # static: fault-free builds add zero ops
+    if fault_on and axis_name is not None:
+        raise ValueError(
+            "fault injection is single-shard only (the link-layer PRNG "
+            "stream is per-system, not per-shard)"
+        )
+    drop_p = float(fault.drop)
     n_local = n // shards
     local_ids = jnp.arange(n_local, dtype=I32)
 
@@ -678,6 +686,63 @@ def build_step(
             point_valid[None, :] & (f["recv"][None, :] == node_ids[:, None])
         ) | inv_hit
 
+        # -- link-layer fault injection (static no-op when fault-free) -
+        # every valid (receiver, candidate) pair must cross the wire:
+        # dropped copies retransmit in-cycle, with the geometric retry
+        # count sampled in closed form (failures = floor(ln u / ln p)).
+        # A candidate that exhausts ``max_retries`` rounds is treated
+        # like a capacity rejection — it defers to the sender's outbox
+        # and the link retries next cycle with fresh randomness.  At
+        # f32 precision u >= ~1e-37, so failures <= ln(1e-37)/ln(p) —
+        # far below any sane budget at moderate rates, which makes the
+        # masked schedule (and the final dumps) exactly the fault-free
+        # one.  A stalled edge also stalls its later candidates this
+        # cycle, keeping per-edge FIFO exact (mirrors spec _deliver).
+        if fault_on:
+            k_drop, k_dup, k_reo, k_del, rng_key = jax.random.split(
+                st.rng_key, 5
+            )
+            applies = jnp.ones((n_local, j), dtype=bool)
+            if fault.edge_sender != -1:
+                applies = applies & (
+                    f["sender"] == fault.edge_sender
+                )[None, :]
+            if fault.edge_receiver != -1:
+                applies = applies & (
+                    node_ids == fault.edge_receiver
+                )[:, None]
+            if drop_p <= 0.0:
+                failures = jnp.zeros((n_local, j), dtype=I32)
+            elif drop_p >= 1.0:
+                failures = jnp.full((n_local, j), fault.max_retries, I32)
+            else:
+                u = jax.random.uniform(
+                    k_drop, (n_local, j), minval=1e-37, maxval=1.0
+                )
+                failures = jnp.minimum(
+                    jnp.floor(jnp.log(u) / jnp.log(drop_p)).astype(I32),
+                    fault.max_retries,
+                )
+            failures = jnp.where(applies & valid_rj, failures, 0)
+            wire_fail = failures >= fault.max_retries
+            # same_sender[k, j'] = candidate j' precedes k on k's edge
+            cand_ids = jnp.arange(j, dtype=I32)
+            same_sender = (
+                f["sender"][:, None] == f["sender"][None, :]
+            ) & (cand_ids[:, None] > cand_ids[None, :])
+            wire_stall = wire_fail | (
+                jnp.einsum(
+                    "rj,kj->rk",
+                    wire_fail.astype(I32),
+                    same_sender.astype(I32),
+                )
+                > 0
+            )
+            valid_ok = valid_rj & ~wire_stall
+        else:
+            rng_key = st.rng_key
+            valid_ok = valid_rj
+
         # capacity backpressure: accept valid candidates in global
         # order until the receiver's mailbox is full; the rest defer to
         # the sender's outbox.  Acceptance is prefix-monotone per
@@ -685,9 +750,9 @@ def build_step(
         # ACCEPTED candidate the exclusive prefix count of valid
         # candidates equals the prefix count of accepted ones — offs
         # stays the exact enqueue position.
-        offs = jnp.cumsum(valid_rj.astype(I32), axis=1) - valid_rj.astype(I32)
+        offs = jnp.cumsum(valid_ok.astype(I32), axis=1) - valid_ok.astype(I32)
         avail = jnp.maximum(cap - mb_count2, 0)
-        accept_rj = valid_rj & (offs < avail[:, None])
+        accept_rj = valid_ok & (offs < avail[:, None])
         delivered = jnp.sum(accept_rj.astype(I32), axis=1)
 
         # TPU gathers/scatters fused into this graph get scalarized
@@ -830,6 +895,7 @@ def build_step(
             ),
             axis=1,
         )  # [len(MsgType)]
+        handled_cnt = cnt(has_msg)
         if axis_name is not None:
             # replicate the global counters so out_specs stay P()
             ov_now = jax.lax.psum(ov_now.astype(I32), axis_name) > 0
@@ -842,7 +908,30 @@ def build_step(
             ev_inc = jax.lax.psum(ev_inc, axis_name)
             inv_inc = jax.lax.psum(inv_inc, axis_name)
             mc_inc = jax.lax.psum(mc_inc, axis_name)
+            handled_cnt = jax.lax.psum(handled_cnt, axis_name)
         overflow = st.overflow | ov_now
+
+        # watchdog progress: an instruction retired or a mailbox
+        # drained this cycle (matches SpecEngine.last_activity_cycle)
+        progressed = (instr_inc > 0) | (handled_cnt > 0)
+        last_progress = jnp.where(progressed, st.cycle, st.last_progress)
+
+        # fault-layer counters (stay exactly zero when fault-free)
+        zero = jnp.zeros((), dtype=I32)
+        retrans_inc = dup_inc = reo_inc = del_inc = wstall_inc = zero
+        if fault_on:
+            retrans_inc = jnp.sum(jnp.where(accept_rj, failures, 0))
+            wstall_inc = jnp.sum((valid_rj & wire_stall).astype(I32))
+
+            def _event_cnt(key, p):
+                if p <= 0.0:
+                    return zero
+                uu = jax.random.uniform(key, (n_local, j))
+                return cnt(accept_rj & applies & (uu < p))
+
+            dup_inc = _event_cnt(k_dup, float(fault.duplicate))
+            reo_inc = _event_cnt(k_reo, float(fault.reorder))
+            del_inc = _event_cnt(k_del, float(fault.delay))
 
         # ============== phase D: dump-at-local-completion =============
         done_node = (
@@ -902,6 +991,13 @@ def build_step(
             n_evictions=st.n_evictions + ev_inc,
             n_invalidations=st.n_invalidations + inv_inc,
             msg_counts=st.msg_counts + mc_inc,
+            rng_key=rng_key,
+            last_progress=last_progress,
+            n_retrans=st.n_retrans + retrans_inc,
+            n_dup_filtered=st.n_dup_filtered + dup_inc,
+            n_reorder_fixed=st.n_reorder_fixed + reo_inc,
+            n_delays=st.n_delays + del_inc,
+            n_wire_stalls=st.n_wire_stalls + wstall_inc,
         )
 
     return step
@@ -923,17 +1019,28 @@ def quiescent(st: SimState) -> jnp.ndarray:
 
 @functools.lru_cache(maxsize=64)
 def build_run(config: SystemConfig, replay: bool = False,
-              max_cycles: int = 1_000_000):
+              max_cycles: int = 1_000_000, watchdog_cycles: int = 0):
     """Jitted run-to-quiescence via lax.while_loop (stays on device).
 
-    Cached per (config, replay, max_cycles) so repeated engine
-    instances reuse the compiled executable (SystemConfig is frozen /
-    hashable).
+    Cached per (config, replay, max_cycles, watchdog_cycles) so
+    repeated engine instances reuse the compiled executable
+    (SystemConfig is frozen / hashable).
+
+    ``watchdog_cycles > 0`` adds the stall watchdog to the loop
+    condition: the loop exits early once no instruction has retired
+    and no mailbox has drained for that many consecutive cycles —
+    the only on-device early-exit for livelocks, which otherwise
+    burn the full ``max_cycles`` budget before the host notices.
     """
     step = build_step(config, replay=replay)
 
     def cond(st):
-        return (~quiescent(st)) & (st.cycle < max_cycles) & (~st.overflow)
+        live = (~quiescent(st)) & (st.cycle < max_cycles) & (~st.overflow)
+        if watchdog_cycles:
+            live = live & (
+                (st.cycle - st.last_progress) < watchdog_cycles
+            )
+        return live
 
     def run(st: SimState) -> SimState:
         return jax.lax.while_loop(cond, step, st)

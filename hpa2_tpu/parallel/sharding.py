@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from hpa2_tpu import hostenv
 from hpa2_tpu.config import SystemConfig
 from hpa2_tpu.models.protocol import Instr
 from hpa2_tpu.models.spec_engine import StallError
@@ -41,7 +42,8 @@ from hpa2_tpu.ops.step import build_step, quiescent
 from hpa2_tpu.utils.dump import NodeDump
 
 # SimState fields whose leading (non-batch) axis is the node axis;
-# everything else (cycle, counters, replay schedule) is replicated.
+# everything else (cycle, counters, replay schedule, fault/watchdog
+# bookkeeping) is replicated.
 _NODE_LEADING = frozenset(
     f
     for f in SimState._fields
@@ -49,7 +51,9 @@ _NODE_LEADING = frozenset(
                  "cycle", "n_instr", "n_msgs", "overflow",
                  "n_read_hits", "n_read_miss", "n_write_hits",
                  "n_write_miss", "n_evictions", "n_invalidations",
-                 "msg_counts")
+                 "msg_counts", "rng_key", "last_progress",
+                 "n_retrans", "n_dup_filtered", "n_reorder_fixed",
+                 "n_delays", "n_wire_stalls")
 )
 
 
@@ -133,12 +137,12 @@ def build_node_sharded_run(
     body = step
     if batched:
         body = jax.vmap(step)
-    wrapped = jax.shard_map(
+    wrapped = hostenv.shard_map(
         body,
         mesh=mesh,
         in_specs=(specs,),
         out_specs=specs,
-        check_vma=False,
+        check_replication=False,
     )
 
     if batched:

@@ -26,10 +26,11 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.config import FaultModel, Semantics, SystemConfig
 from hpa2_tpu.ops.state import SimState
 
 _MAGIC = "hpa2_checkpoint_v1"
+_SPEC_MAGIC = "hpa2_spec_checkpoint_v1"
 
 
 def _config_to_json(config: SystemConfig) -> str:
@@ -40,6 +41,8 @@ def _config_to_json(config: SystemConfig) -> str:
 def _config_from_json(text: str) -> SystemConfig:
     d = json.loads(text)
     d["semantics"] = Semantics(**d["semantics"])
+    if "fault" in d:
+        d["fault"] = FaultModel(**d["fault"])
     return SystemConfig(**d)
 
 
@@ -88,6 +91,187 @@ def load_state(path: str, with_meta: bool = False):
     if with_meta:
         return state, config, extra
     return state, config
+
+
+# -- spec-engine checkpointing (crash-resume) -------------------------
+#
+# The spec engine is plain Python objects, so its checkpoint is JSON:
+# every node's architectural state (memory/directory/cache), the
+# mailbox and backpressure queues mid-flight, the engine's schedule
+# position, counters, logs, and — critically for fault injection —
+# the exact ``random.Random`` state of the link-layer PRNG, so a run
+# killed at cycle N and resumed continues on the *same* fault stream
+# and finishes byte-identical to an uninterrupted run.
+
+
+def _msg_to_list(m) -> list:
+    return [int(m.type), m.sender, m.address, m.value, m.sharers,
+            m.second_receiver]
+
+
+def _msg_from_list(row) -> "object":
+    from hpa2_tpu.models.protocol import Message, MsgType
+
+    t, sender, address, value, sharers, second = row
+    return Message(MsgType(t), sender, address, value, sharers, second)
+
+
+def _dump_to_dict(d) -> dict:
+    return {
+        "proc_id": d.proc_id,
+        "memory": list(d.memory),
+        "dir_state": [int(s) for s in d.dir_state],
+        "dir_sharers": list(d.dir_sharers),
+        "cache_addr": list(d.cache_addr),
+        "cache_value": list(d.cache_value),
+        "cache_state": [int(s) for s in d.cache_state],
+    }
+
+
+def _dump_from_dict(d) -> "object":
+    from hpa2_tpu.models.protocol import CacheState, DirState
+    from hpa2_tpu.utils.dump import NodeDump
+
+    return NodeDump(
+        proc_id=d["proc_id"],
+        memory=list(d["memory"]),
+        dir_state=[DirState(s) for s in d["dir_state"]],
+        dir_sharers=list(d["dir_sharers"]),
+        cache_addr=list(d["cache_addr"]),
+        cache_value=list(d["cache_value"]),
+        cache_state=[CacheState(s) for s in d["cache_state"]],
+    )
+
+
+def save_spec_state(path: str, engine) -> None:
+    """Atomically serialize a ``SpecEngine`` mid-run to ``path``
+    (JSON).  Checkpoint at a cycle boundary (between ``step()`` calls);
+    ``load_spec_state`` rebuilds an engine that continues
+    bit-identically, fault stream included."""
+    if engine._outbox:
+        raise ValueError(
+            "checkpoint only at a cycle boundary (outbox not drained)"
+        )
+    doc = {
+        "magic": _SPEC_MAGIC,
+        "config": json.loads(_config_to_json(engine.config)),
+        "cycle": engine.cycle,
+        "order_pos": engine.order_pos,
+        "replay_batched": engine.replay_batched,
+        "replay_order": (
+            None if engine.replay_order is None
+            else [dataclasses.astuple(r) for r in engine.replay_order]
+        ),
+        "counters": dict(engine.counters),
+        "max_mailbox_depth": engine.max_mailbox_depth,
+        "issue_log": [dataclasses.astuple(r) for r in engine.issue_log],
+        "trace_msgs": engine.trace_msgs,
+        "msg_log": list(engine.msg_log),
+        "debug_invariants": engine.debug_invariants,
+        "last_activity_cycle": engine.last_activity_cycle,
+        "recent_msgs": [list(e) for e in engine.recent_msgs.entries()],
+        "fault_rng": (
+            None if engine._fault_rng is None
+            else list(engine._fault_rng.getstate())
+        ),
+        "nodes": [
+            {
+                "memory": list(n.memory),
+                "dir": [[int(e.state), e.sharers] for e in n.directory],
+                "cache": [[l.address, l.value, int(l.state)]
+                          for l in n.cache],
+                "trace": [[i.op, i.address, i.value] for i in n.trace],
+                "pc": n.pc,
+                "waiting": n.waiting,
+                "pending_write": n.pending_write,
+                "mailbox": [_msg_to_list(m) for m in n.mailbox],
+                "pending_sends": [
+                    [ph, rcv, _msg_to_list(m)]
+                    for ph, rcv, m in n.pending_sends
+                ],
+                "dumped": n.dumped,
+                "snapshot": (
+                    None if n.snapshot is None
+                    else _dump_to_dict(n.snapshot)
+                ),
+                "dump_candidates": [
+                    _dump_to_dict(d) for d in n.dump_candidates
+                ],
+            }
+            for n in engine.nodes
+        ],
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+def load_spec_state(path: str):
+    """Rebuild the ``SpecEngine`` saved by ``save_spec_state``."""
+    from hpa2_tpu.models.protocol import CacheState, DirState, Instr
+    from hpa2_tpu.models.spec_engine import SpecEngine
+    from hpa2_tpu.utils.trace import IssueRecord
+
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("magic") != _SPEC_MAGIC:
+        raise ValueError(f"{path}: not a hpa2 spec checkpoint")
+    config = _config_from_json(json.dumps(doc["config"]))
+    traces = [
+        [Instr(op, addr, val) for op, addr, val in nd["trace"]]
+        for nd in doc["nodes"]
+    ]
+    engine = SpecEngine(
+        config,
+        traces,
+        replay_order=(
+            None if doc["replay_order"] is None
+            else [IssueRecord(*row) for row in doc["replay_order"]]
+        ),
+        replay_batched=doc["replay_batched"],
+        trace_msgs=doc["trace_msgs"],
+        debug_invariants=doc["debug_invariants"],
+    )
+    engine.cycle = doc["cycle"]
+    engine.order_pos = doc["order_pos"]
+    engine.counters.update(doc["counters"])
+    engine.max_mailbox_depth = doc["max_mailbox_depth"]
+    engine.issue_log = [IssueRecord(*row) for row in doc["issue_log"]]
+    engine.msg_log = list(doc["msg_log"])
+    engine.last_activity_cycle = doc["last_activity_cycle"]
+    for entry in doc["recent_msgs"]:
+        engine.recent_msgs.push(tuple(entry))
+    if doc["fault_rng"] is not None:
+        st = doc["fault_rng"]
+        engine._fault_rng.setstate((st[0], tuple(st[1]), st[2]))
+    for node, nd in zip(engine.nodes, doc["nodes"]):
+        node.memory = list(nd["memory"])
+        for entry, (ds, sharers) in zip(node.directory, nd["dir"]):
+            entry.state = DirState(ds)
+            entry.sharers = sharers
+        for line, (addr, val, cs) in zip(node.cache, nd["cache"]):
+            line.address = addr
+            line.value = val
+            line.state = CacheState(cs)
+        node.pc = nd["pc"]
+        node.waiting = nd["waiting"]
+        node.pending_write = nd["pending_write"]
+        node.mailbox.clear()
+        node.mailbox.extend(_msg_from_list(r) for r in nd["mailbox"])
+        node.pending_sends = [
+            (ph, rcv, _msg_from_list(m))
+            for ph, rcv, m in nd["pending_sends"]
+        ]
+        node.dumped = nd["dumped"]
+        node.snapshot = (
+            None if nd["snapshot"] is None
+            else _dump_from_dict(nd["snapshot"])
+        )
+        node.dump_candidates = [
+            _dump_from_dict(d) for d in nd["dump_candidates"]
+        ]
+    return engine
 
 
 def latest_checkpoint(directory: str, stem: str = "ckpt") -> Optional[str]:

@@ -21,13 +21,51 @@ File formats are the reference's (README.md:55-68):
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import os
 import re
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 from hpa2_tpu.config import SystemConfig
-from hpa2_tpu.models.protocol import Instr
+from hpa2_tpu.models.protocol import Instr, MsgType
+
+
+class TraceRing:
+    """Bounded ring of recent interconnect deliveries for stall
+    diagnostics (the "flight recorder" a watchdog dumps).
+
+    Recording sits on the delivery hot path, so it is a bare tuple
+    append into a bounded deque; formatting is deferred to
+    :meth:`lines`, which only the diagnostic path calls.
+    """
+
+    def __init__(self, maxlen: int = 64):
+        self.maxlen = maxlen
+        self._ring: "collections.deque[Tuple[int, int, int, int, int]]" = (
+            collections.deque(maxlen=maxlen)
+        )
+
+    def record(
+        self, cycle: int, sender: int, receiver: int, mtype: int, address: int
+    ) -> None:
+        self._ring.append((cycle, sender, receiver, mtype, address))
+
+    def push(self, entry: Tuple[int, int, int, int, int]) -> None:
+        """Re-append a raw entry (checkpoint restore)."""
+        self._ring.append(entry)
+
+    def entries(self) -> List[Tuple[int, int, int, int, int]]:
+        return list(self._ring)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def lines(self) -> List[str]:
+        return [
+            f"cycle {c}: {s} -> {r} {MsgType(t).name} 0x{a:02X}"
+            for c, s, r, t, a in self._ring
+        ]
 
 _RD_RE = re.compile(r"^RD\s+(?:0[xX])?([0-9a-fA-F]+)\s*$")
 _WR_RE = re.compile(r"^WR\s+(?:0[xX])?([0-9a-fA-F]+)\s+(\d+)\s*$")
