@@ -1,0 +1,33 @@
+"""Static protocol analysis: declarative transition table, whole-table
+checks, cross-backend equivalence extraction, and a JAX-pitfall lint.
+
+The MESI/directory transition logic lives in four executable places —
+``models/spec_engine.py``, the JAX ``ops/step.py``, the Pallas kernel,
+and ``native/src/sim.cpp`` — guarded so far only by *dynamic*
+differential tests.  This package makes the transition relation a
+first-class artifact:
+
+* ``table``   — the declarative ``TransitionTable`` (one ``Row`` per
+  role x state x event x guard-case), built per ``Semantics`` variant.
+* ``checks``  — static whole-table checks: completeness, determinism,
+  no-silent-drop, state-product consistency, reply-guarantee.
+* ``extract`` — probe-based extraction of the *effective* table from
+  each backend (spec / JAX / native via a C API probe), diffed against
+  the declarative table.
+* ``mutate``  — seeded table mutations for the analyzer self-test.
+* ``lint``    — AST lint for JAX pitfalls and dead spec handlers.
+
+CLI: ``python -m hpa2_tpu.analysis {check,lint,equiv,mutation-test}``.
+"""
+
+from hpa2_tpu.analysis.table import Emit, Row, TransitionTable, Unreachable, build_table
+from hpa2_tpu.analysis.checks import run_static_checks
+
+__all__ = [
+    "Emit",
+    "Row",
+    "TransitionTable",
+    "Unreachable",
+    "build_table",
+    "run_static_checks",
+]

@@ -1,0 +1,157 @@
+"""CLI for the static protocol analysis suite.
+
+Subcommands::
+
+    python -m hpa2_tpu.analysis check          # static checks + spec equiv
+    python -m hpa2_tpu.analysis lint           # JAX-pitfall / dead-handler lint
+    python -m hpa2_tpu.analysis equiv          # cross-backend table diff
+    python -m hpa2_tpu.analysis mutation-test  # analyzer self-test
+
+``check`` is the cheap gate (pure Python, no JAX import): whole-table
+static checks plus the spec-engine equivalence diff, on both the
+default and robust semantics.  ``equiv`` extends the diff to the JAX
+and native backends.  All subcommands exit non-zero on failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from hpa2_tpu.config import Semantics
+
+_SEMS = {
+    "default": lambda: Semantics(),
+    "robust": lambda: Semantics().robust(),
+    "head": lambda: Semantics().head_quirks(),
+}
+
+
+def _table_report(name: str, sem: Semantics, verbose: bool) -> int:
+    from hpa2_tpu.analysis.table import build_table
+    from hpa2_tpu.analysis.checks import run_static_checks
+
+    table = build_table(sem)
+    findings = run_static_checks(table)
+    errors = [f for f in findings if f.severity == "error"]
+    warnings = [f for f in findings if f.severity != "error"]
+    print(f"[{name}] {len(table.rows)} rows, "
+          f"{len(table.unreachable)} unreachable declarations, "
+          f"{len(errors)} errors, {len(warnings)} warnings")
+    shown = findings if verbose else errors
+    for f in shown:
+        print(f"  {f}")
+    return len(errors)
+
+
+def cmd_check(args: argparse.Namespace) -> int:
+    from hpa2_tpu.analysis.table import build_table
+    from hpa2_tpu.analysis.extract import diff_backend
+
+    rc = 0
+    for name in args.sem:
+        sem = _SEMS[name]()
+        rc += _table_report(name, sem, args.verbose)
+        diffs = diff_backend(build_table(sem), "spec")
+        print(f"[{name}] spec equivalence: {len(diffs)} diffs")
+        for d in diffs[:20]:
+            print(f"  {d}")
+        rc += len(diffs)
+    return 1 if rc else 0
+
+
+def cmd_lint(args: argparse.Namespace) -> int:
+    from hpa2_tpu.analysis.lint import run_lint
+
+    findings = run_lint(args.root)
+    for f in findings:
+        print(f)
+    print(f"{len(findings)} lint findings")
+    return 1 if findings else 0
+
+
+def cmd_equiv(args: argparse.Namespace) -> int:
+    from hpa2_tpu.analysis.table import build_table
+    from hpa2_tpu.analysis.extract import diff_backend
+
+    total = 0
+    for name in args.sem:
+        sem = _SEMS[name]()
+        table = build_table(sem)
+        for backend in args.backends:
+            if backend == "jax" and sem.overloaded_evict_shared_notify:
+                # the JAX backend refuses to build the overloaded
+                # notify quirk; nothing to extract
+                print(f"[{name}] jax: skipped (overloaded quirk "
+                      f"unsupported by the JAX backend)")
+                continue
+            try:
+                diffs = diff_backend(table, backend)
+            except Exception as e:  # e.g. native toolchain missing
+                if backend == "native" and args.allow_missing_native:
+                    print(f"[{name}] native: skipped ({e})")
+                    continue
+                raise
+            print(f"[{name}] {backend}: {len(diffs)} diffs")
+            for d in diffs[:20]:
+                print(f"  {d}")
+            total += len(diffs)
+    return 1 if total else 0
+
+
+def cmd_mutation_test(args: argparse.Namespace) -> int:
+    from hpa2_tpu.analysis.mutate import run_all_mutations
+
+    results = run_all_mutations(_SEMS[args.sem[0]]())
+    missed = 0
+    for r in results:
+        status = f"caught by {r.caught_by}" if r.caught else "MISSED"
+        print(f"{r.name:24s} {status}")
+        if args.verbose or not r.caught:
+            for e in r.evidence:
+                print(f"    {e}")
+        missed += 0 if r.caught else 1
+    print(f"{len(results) - missed}/{len(results)} mutations caught")
+    return 1 if missed else 0
+
+
+def main(argv=None) -> int:
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    p = argparse.ArgumentParser(prog="python -m hpa2_tpu.analysis")
+    p.add_argument("--sem", default="default,robust",
+                   help="comma-separated semantics variants "
+                        "(default,robust,head)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("check", help="static checks + spec equivalence")
+    lp = sub.add_parser("lint", help="JAX-pitfall / dead-handler lint")
+    lp.add_argument("--root", default=repo_root)
+    ep = sub.add_parser("equiv", help="cross-backend table diff")
+    ep.add_argument("--backends", default="spec,jax,native",
+                    help="comma-separated: spec,jax,native")
+    ep.add_argument("--allow-missing-native", action="store_true",
+                    help="skip (not fail) when the native build is "
+                         "unavailable")
+    sub.add_parser("mutation-test", help="analyzer self-test")
+    args = p.parse_args(argv)
+    args.sem = [s.strip() for s in args.sem.split(",") if s.strip()]
+    for s in args.sem:
+        if s not in _SEMS:
+            p.error(f"unknown semantics variant {s!r}")
+    if hasattr(args, "backends"):
+        args.backends = [b.strip() for b in args.backends.split(",")]
+        for b in args.backends:
+            if b not in ("spec", "jax", "native"):
+                p.error(f"unknown backend {b!r}")
+    return {
+        "check": cmd_check,
+        "lint": cmd_lint,
+        "equiv": cmd_equiv,
+        "mutation-test": cmd_mutation_test,
+    }[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

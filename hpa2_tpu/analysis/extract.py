@@ -1,0 +1,539 @@
+"""Probe-based cross-backend transition-table extraction.
+
+For every declared ``Row`` this module builds one concrete single-node
+scenario (states, sentinel values, one message or instruction), runs it
+through a backend, and diffs the observed effect against the row's
+symbolic claim resolved over the same scenario.  Three backends share
+the scenario set:
+
+* **spec**   — ``SpecEngine._handle`` / ``_issue`` called directly on a
+  crafted node; emissions read from the engine outbox.
+* **jax**    — one ``build_step_jitted`` cycle over a crafted
+  ``SimState``; emissions read from the other nodes' mailboxes after
+  end-of-cycle delivery (nobody else acts: empty traces, empty boxes).
+* **native** — the ``hpa2_probe_transition`` C API (a packed
+  setup/observe probe added to ``capi.cpp`` for exactly this purpose).
+
+Sentinel values make data-flow claims checkable: memory holds 77, the
+preloaded line 55, ``pending_write`` 66, the message payload 88, the
+instruction payload 99 — so e.g. a REPLY_WR that filled the line from
+the message instead of the pending write is a visible diff, not a
+coincidence.
+
+The reference geometry (4 nodes / 4 lines / 16 blocks) fixes the cast:
+address 19 lives at home 1 / block 3 / line 3; the victim address 51
+shares line 3 but homes at node 3.  The probed home node is 1, the
+probed cache node 2, the requester 2, the displaced owner 3.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.models.protocol import (
+    CacheState,
+    DirState,
+    Instr,
+    INVALID_ADDR,
+    Message,
+    MsgType,
+    NO_PROC,
+    bit,
+)
+from hpa2_tpu.analysis.table import Row, TransitionTable, build_table
+
+# sentinels (see module docstring)
+MEM_SENTINEL = 77
+LINE_SENTINEL = 55
+PENDING_SENTINEL = 66
+MSG_SENTINEL = 88
+INSTR_SENTINEL = 99
+
+# cast and geometry (reference config 4/4/16)
+ADDR = 19          # home 1, block 3, cache line 3
+VICTIM_ADDR = 51   # home 3, block 3, cache line 3 (same line, other home)
+HOME = 1
+CACHE_NODE = 2
+REQUESTER = 2
+OWNER = 3
+OTHER = 0
+
+_CACHE_NUM = {"M": 0, "E": 1, "S": 2, "I": 3}
+_DIR_NUM = {"EM": 0, "S": 1, "U": 2}
+
+#: initial directory sharer masks per (event, state, case) — chosen so
+#: every symbolic update resolves to a distinct concrete mask
+_HOME_SHARERS: Dict[Tuple[str, str], int] = {
+    ("READ_REQUEST", "U"): 0,
+    ("READ_REQUEST", "S"): bit(OTHER) | bit(OWNER),
+    ("WRITE_REQUEST", "U"): 0,
+    ("WRITE_REQUEST", "S"): bit(OTHER) | bit(OWNER),
+    ("UPGRADE", "U"): 0,
+    ("UPGRADE", "S"): bit(OTHER) | bit(REQUESTER) | bit(OWNER),
+    ("UPGRADE", "EM"): bit(OWNER),
+    ("EVICT_SHARED", "U"): 0,
+    ("EVICT_MODIFIED", "U"): 0,
+    ("EVICT_MODIFIED", "S"): bit(OTHER) | bit(OWNER),
+    ("FLUSH", "U"): 0,
+    ("FLUSH", "S"): bit(OTHER) | bit(REQUESTER),
+    ("FLUSH", "EM"): bit(OWNER),
+    ("FLUSH_INVACK", "U"): 0,
+    ("FLUSH_INVACK", "S"): bit(OTHER) | bit(REQUESTER),
+    ("FLUSH_INVACK", "EM"): bit(OWNER),
+    ("NACK", "S"): bit(OTHER) | bit(OWNER),
+    ("NACK", "EM"): bit(OWNER),
+}
+_HOME_SHARERS_BY_CASE: Dict[str, int] = {
+    "owner_is_requester": bit(REQUESTER),
+    "owner_is_other": bit(OWNER),
+    "sender_only_sharer": bit(REQUESTER),
+    "two_sharers": bit(REQUESTER) | bit(OWNER),
+    "many_sharers": bit(OTHER) | bit(REQUESTER) | bit(OWNER),
+    "sender_not_sharer": bit(OTHER) | bit(OWNER),
+    "sender_is_owner": bit(REQUESTER),
+    "sender_not_owner": bit(OWNER),
+}
+
+#: REPLY_ID fan-out mask: includes the receiver itself to prove the
+#: self-exclusion — expected INVs go to OTHER and OWNER only
+_FANOUT_MASK = bit(OTHER) | bit(CACHE_NODE) | bit(OWNER)
+_FANOUT_TARGETS = (OTHER, OWNER)
+
+
+@dataclasses.dataclass
+class Scenario:
+    """One concrete probe setup (receiver-node state + stimulus)."""
+
+    receiver: int
+    is_instr: bool = False
+    instr_op: str = "R"
+    instr_addr: int = ADDR
+    instr_value: int = 0
+    msg_type: int = 0
+    msg_sender: int = HOME
+    msg_addr: int = ADDR
+    msg_value: int = 0
+    msg_sharers: int = 0
+    msg_second: int = NO_PROC
+    line_index: int = 3
+    line_addr: int = INVALID_ADDR
+    line_value: int = 0
+    line_state: int = int(CacheState.INVALID)
+    dir_blk: int = 3
+    dir_state: int = int(DirState.U)
+    dir_sharers: int = 0
+    mem_blk: int = 3
+    mem_value: int = MEM_SENTINEL
+    pending: int = PENDING_SENTINEL
+    waiting: bool = False
+
+
+@dataclasses.dataclass
+class Observed:
+    """Post-transition state of the probed node plus its emissions.
+
+    ``emits`` entries are ``(receiver, type, value, second, sharers)``
+    with ``None`` meaning "don't care" (only produced on the expected
+    side)."""
+
+    line_addr: int
+    line_value: int
+    line_state: int
+    dir_state: int
+    dir_sharers: int
+    mem_value: int
+    waiting: bool
+    emits: List[Tuple]
+
+    def normalized(self) -> "Observed":
+        return dataclasses.replace(
+            self, emits=sorted(self.emits, key=lambda e: (e[0], e[1]))
+        )
+
+
+# ---------------------------------------------------------------------------
+# scenario construction
+# ---------------------------------------------------------------------------
+
+
+def scenario_for(row: Row) -> Optional[Scenario]:
+    """Concrete probe setup for one declared row (None = not probeable:
+    the row's guard needs multi-node context the probe cannot stage)."""
+    if row.role == "home":
+        return _home_scenario(row)
+    return _cache_scenario(row)
+
+
+def _home_scenario(row: Row) -> Scenario:
+    scn = Scenario(receiver=HOME)
+    scn.dir_state = _DIR_NUM[row.state]
+    scn.dir_sharers = _HOME_SHARERS_BY_CASE.get(
+        row.case, _HOME_SHARERS.get((row.event, row.state), 0)
+    )
+    scn.msg_type = int(MsgType[row.event])
+    scn.msg_sender = REQUESTER
+    if row.event in ("FLUSH", "FLUSH_INVACK"):
+        scn.msg_sender = OWNER
+        scn.msg_second = OTHER
+        scn.msg_value = MSG_SENTINEL
+    elif row.event == "NACK":
+        scn.msg_sender = OWNER
+        scn.msg_second = REQUESTER
+        scn.msg_sharers = 1 if row.case == "write_intervention" else 0
+    elif row.event in ("WRITE_REQUEST", "EVICT_MODIFIED"):
+        scn.msg_value = MSG_SENTINEL
+    return scn
+
+
+def _cache_scenario(row: Row) -> Scenario:
+    scn = Scenario(receiver=CACHE_NODE)
+    case = row.case
+    # line setup: match cases hold the probed address, victim/other
+    # cases a displaced one, INVALID-state cells the placeholder fill
+    if row.state == "I":
+        scn.line_state = int(CacheState.INVALID)
+        scn.line_addr = VICTIM_ADDR if case == "other" else ADDR
+        scn.line_value = 0
+    else:
+        scn.line_state = _CACHE_NUM[row.state]
+        scn.line_value = LINE_SENTINEL
+        if case.startswith(("victim", "miss_victim")) or case == "other":
+            scn.line_addr = VICTIM_ADDR
+        else:
+            scn.line_addr = ADDR
+    if row.event in ("INSTR_R", "INSTR_W"):
+        scn.is_instr = True
+        scn.instr_op = "R" if row.event == "INSTR_R" else "W"
+        scn.instr_value = INSTR_SENTINEL if row.event == "INSTR_W" else 0
+        return scn
+    scn.msg_type = int(MsgType[row.event])
+    if row.event in ("REPLY_RD", "REPLY_WR", "REPLY_ID", "FLUSH",
+                     "FLUSH_INVACK"):
+        scn.waiting = True
+    if row.event == "REPLY_RD":
+        scn.msg_value = MSG_SENTINEL
+        scn.msg_sharers = 2 if case.endswith("excl") else 0
+    elif row.event == "REPLY_ID":
+        scn.msg_sharers = _FANOUT_MASK
+    elif row.event in ("FLUSH", "FLUSH_INVACK"):
+        scn.msg_sender = OWNER
+        scn.msg_second = CACHE_NODE
+        scn.msg_value = MSG_SENTINEL
+    elif row.event in ("WRITEBACK_INT", "WRITEBACK_INV"):
+        scn.msg_sender = HOME
+        scn.msg_second = HOME if case == "match_second_home" else OTHER
+    elif row.event in ("UPGRADE_NOTIFY", "EVICT_SHARED"):
+        scn.msg_sender = OWNER if case == "match_not_home" else HOME
+    elif row.event == "INV":
+        scn.msg_sender = OTHER
+    return scn
+
+
+# ---------------------------------------------------------------------------
+# expected observation (the row's symbolic claim resolved over the
+# scenario)
+# ---------------------------------------------------------------------------
+
+
+def _resolve_sharers(update: str, init: int, second: int) -> int:
+    if update in ("", "same"):
+        return init
+    if update == "empty":
+        return 0
+    if update == "requester":
+        return bit(REQUESTER)
+    if update == "+requester":
+        return init | bit(REQUESTER)
+    if update == "-sender":
+        return init & ~bit(REQUESTER)
+    if update == "second":
+        return bit(second)
+    if update == "+second":
+        return init | bit(second)
+    raise ValueError(f"unknown sharer update {update!r}")
+
+
+def _emit_value(src: str) -> Optional[int]:
+    return {"": None, "mem": MEM_SENTINEL, "line": LINE_SENTINEL,
+            "instr": INSTR_SENTINEL}[src]
+
+
+def _emit_sharers(sym: str, init_sharers: int) -> Optional[int]:
+    if sym == "":
+        return None
+    if sym == "excl":
+        return 2
+    if sym in ("shared", "none", "rd"):
+        return 0
+    if sym == "wr":
+        return 1
+    if sym == "others":
+        return init_sharers & ~bit(REQUESTER)
+    raise ValueError(f"unknown emission sharer symbol {sym!r}")
+
+
+def expected_for(row: Row, scn: Scenario) -> Observed:
+    if row.role == "home":
+        dir_state = _DIR_NUM[row.next_state]
+        dir_sharers = _resolve_sharers(
+            row.sharers, scn.dir_sharers, scn.msg_second
+        )
+        line = (scn.line_addr, scn.line_value, scn.line_state)
+    else:
+        dir_state = scn.dir_state
+        dir_sharers = scn.dir_sharers
+        fill = {"msg": MSG_SENTINEL, "pending": PENDING_SENTINEL,
+                "instr": INSTR_SENTINEL, "placeholder": 0}
+        if row.value_src:
+            tgt = scn.instr_addr if scn.is_instr else scn.msg_addr
+            line = (tgt, fill[row.value_src], _CACHE_NUM[row.next_state])
+        else:
+            line = (scn.line_addr, scn.line_value,
+                    _CACHE_NUM[row.next_state])
+    mem = MSG_SENTINEL if row.writes_memory else scn.mem_value
+    waiting = row.sets_waiting or (scn.waiting and not row.clears_waiting)
+
+    emits: List[Tuple] = []
+    targets = {
+        "requester": REQUESTER, "owner": OWNER, "home": HOME,
+        "second": scn.msg_second, "survivor": OWNER,
+        "victim_home": VICTIM_ADDR // 16,
+    }
+    seconds = {"": None, "requester": REQUESTER, "fwd": scn.msg_second}
+    for e in row.emits:
+        mtype = int(MsgType[e.type])
+        value = _emit_value(e.value)
+        second = seconds[e.second]
+        sharers = _emit_sharers(e.sharers, scn.dir_sharers)
+        if e.to == "sharers":
+            emits.extend(
+                (t, mtype, value, second, sharers) for t in _FANOUT_TARGETS
+            )
+        else:
+            emits.append((targets[e.to], mtype, value, second, sharers))
+    return Observed(
+        line_addr=line[0], line_value=line[1], line_state=line[2],
+        dir_state=dir_state, dir_sharers=dir_sharers, mem_value=mem,
+        waiting=waiting, emits=emits,
+    ).normalized()
+
+
+# ---------------------------------------------------------------------------
+# backend probes
+# ---------------------------------------------------------------------------
+
+
+def probe_spec(scn: Scenario, sem: Semantics) -> Observed:
+    from hpa2_tpu.models.spec_engine import SpecEngine
+
+    cfg = SystemConfig(semantics=sem)
+    eng = SpecEngine(cfg, [[] for _ in range(cfg.num_procs)])
+    node = eng.nodes[scn.receiver]
+    line = node.cache[scn.line_index]
+    line.address = scn.line_addr
+    line.value = scn.line_value
+    line.state = CacheState(scn.line_state)
+    entry = node.directory[scn.dir_blk]
+    entry.state = DirState(scn.dir_state)
+    entry.sharers = scn.dir_sharers
+    node.memory[scn.mem_blk] = scn.mem_value
+    node.pending_write = scn.pending
+    node.waiting = scn.waiting
+    if scn.is_instr:
+        node.trace = [Instr(scn.instr_op, scn.instr_addr, scn.instr_value)]
+        node.pc = 0
+        eng._issue(node)
+    else:
+        eng._handle(node, Message(
+            MsgType(scn.msg_type), scn.msg_sender, scn.msg_addr,
+            value=scn.msg_value, sharers=scn.msg_sharers,
+            second_receiver=scn.msg_second,
+        ))
+    emits = [
+        (recv, int(m.type), m.value, m.second_receiver, m.sharers)
+        for (_ph, _snd, recv, m) in eng._outbox
+    ]
+    return Observed(
+        line_addr=line.address, line_value=line.value,
+        line_state=int(line.state), dir_state=int(entry.state),
+        dir_sharers=entry.sharers,
+        mem_value=node.memory[scn.mem_blk], waiting=node.waiting,
+        emits=emits,
+    ).normalized()
+
+
+def probe_native(scn: Scenario, sem: Semantics) -> Observed:
+    from hpa2_tpu import native
+
+    cfg = SystemConfig(semantics=sem)
+    out = native.probe_transition(cfg, _native_packed(scn))
+    emits = [
+        tuple(out[8 + 5 * i: 8 + 5 * (i + 1)]) for i in range(out[7])
+    ]
+    return Observed(
+        line_addr=out[0], line_value=out[1], line_state=out[2],
+        dir_state=out[3], dir_sharers=out[4], mem_value=out[5],
+        waiting=bool(out[6]), emits=emits,
+    ).normalized()
+
+
+def _native_packed(scn: Scenario) -> List[int]:
+    """Input layout of the hpa2_probe_transition C API (capi.cpp)."""
+    return [
+        scn.receiver, int(scn.is_instr),
+        1 if scn.instr_op == "W" else 0, scn.instr_addr, scn.instr_value,
+        scn.msg_type, scn.msg_sender, scn.msg_addr, scn.msg_value,
+        scn.msg_sharers, scn.msg_second,
+        scn.line_index, scn.line_addr, scn.line_value, scn.line_state,
+        scn.dir_blk, scn.dir_state, scn.dir_sharers,
+        scn.mem_blk, scn.mem_value,
+        scn.pending, int(scn.waiting),
+    ]
+
+
+class JaxProber:
+    """Shared jitted step for a batch of JAX probes (one compile)."""
+
+    def __init__(self, sem: Semantics):
+        from hpa2_tpu.ops.step import build_step_jitted
+        from hpa2_tpu.ops.state import init_state
+
+        self.cfg = SystemConfig(semantics=sem)
+        self.step = build_step_jitted(self.cfg)
+        # one instruction slot so msg- and instr-probes share shapes
+        # (init_state pads empty traces to length 1)
+        self.base = init_state(
+            self.cfg, [[] for _ in range(self.cfg.num_procs)]
+        )
+
+    def probe(self, scn: Scenario) -> Observed:
+        import numpy as np
+
+        from hpa2_tpu.ops.state import (
+            MB_ADDR, MB_SECOND, MB_SENDER, MB_SHARERS, MB_TYPE, MB_VALUE,
+        )
+
+        st = self.base
+        r = scn.receiver
+        st = st._replace(
+            cache_addr=st.cache_addr.at[r, scn.line_index].set(scn.line_addr),
+            cache_val=st.cache_val.at[r, scn.line_index].set(scn.line_value),
+            cache_state=st.cache_state.at[r, scn.line_index].set(
+                scn.line_state),
+            dir_state=st.dir_state.at[r, scn.dir_blk].set(scn.dir_state),
+            dir_sharers=st.dir_sharers.at[r, scn.dir_blk, 0].set(
+                scn.dir_sharers),
+            mem=st.mem.at[r, scn.mem_blk].set(scn.mem_value),
+            pending_write=st.pending_write.at[r].set(scn.pending),
+            waiting=st.waiting.at[r].set(scn.waiting),
+        )
+        if scn.is_instr:
+            st = st._replace(
+                tr_op=st.tr_op.at[r, 0].set(
+                    0 if scn.instr_op == "R" else 1),
+                tr_addr=st.tr_addr.at[r, 0].set(scn.instr_addr),
+                tr_val=st.tr_val.at[r, 0].set(scn.instr_value),
+                tr_len=st.tr_len.at[r].set(1),
+            )
+        else:
+            packed = [scn.msg_type, scn.msg_sender, scn.msg_addr,
+                      scn.msg_value, scn.msg_second, scn.msg_sharers]
+            st = st._replace(
+                mb_data=st.mb_data.at[r, 0, :6].set(
+                    np.asarray(packed, dtype=np.int32)),
+                mb_count=st.mb_count.at[r].set(1),
+            )
+        nxt = self.step(st)
+        emits = []
+        for j in range(self.cfg.num_procs):
+            if j == r:
+                continue
+            for k in range(int(nxt.mb_count[j])):
+                row = np.asarray(nxt.mb_data[j, k])
+                emits.append((j, int(row[MB_TYPE]), int(row[MB_VALUE]),
+                              int(row[MB_SECOND]), int(row[MB_SHARERS])))
+        del MB_SENDER, MB_ADDR  # sender/addr are fixed by the scenario
+        return Observed(
+            line_addr=int(nxt.cache_addr[r, scn.line_index]),
+            line_value=int(nxt.cache_val[r, scn.line_index]),
+            line_state=int(nxt.cache_state[r, scn.line_index]),
+            dir_state=int(nxt.dir_state[r, scn.dir_blk]),
+            dir_sharers=int(nxt.dir_sharers[r, scn.dir_blk, 0]),
+            mem_value=int(nxt.mem[r, scn.mem_blk]),
+            waiting=bool(nxt.waiting[r]),
+            emits=emits,
+        ).normalized()
+
+
+# ---------------------------------------------------------------------------
+# diffing
+# ---------------------------------------------------------------------------
+
+
+def _diff_observed(where: str, exp: Observed, obs: Observed) -> List[str]:
+    out = []
+    for field in ("line_addr", "line_value", "line_state", "dir_state",
+                  "dir_sharers", "mem_value", "waiting"):
+        e, o = getattr(exp, field), getattr(obs, field)
+        if e != o:
+            out.append(f"{where}: {field} expected {e} observed {o}")
+    if len(exp.emits) != len(obs.emits):
+        out.append(
+            f"{where}: expected {len(exp.emits)} emissions "
+            f"{[(e[0], e[1]) for e in exp.emits]}, observed "
+            f"{len(obs.emits)} {[(o[0], o[1]) for o in obs.emits]}")
+        return out
+    names = ("receiver", "type", "value", "second", "sharers")
+    for e, o in zip(exp.emits, obs.emits):
+        for i, name in enumerate(names):
+            if e[i] is not None and e[i] != o[i]:
+                out.append(
+                    f"{where}: emission {names[1]}={e[1]} "
+                    f"{name} expected {e[i]} observed {o[i]}")
+    return out
+
+
+def probeable_rows(table: TransitionTable) -> List[Row]:
+    return [r for r in table.rows
+            if not table.is_unreachable(*r.key)]
+
+
+def diff_backend(
+    table: TransitionTable,
+    backend: str,
+    rows: Optional[Sequence[Row]] = None,
+) -> List[str]:
+    """Diff the backend's effective table against the declared one.
+
+    Returns one human-readable line per mismatch (empty = equivalent).
+    """
+    sem = table.semantics
+    rows = list(rows) if rows is not None else probeable_rows(table)
+    diffs: List[str] = []
+    prober = None
+    if backend == "jax":
+        prober = JaxProber(sem)
+    for row in rows:
+        scn = scenario_for(row)
+        if scn is None:
+            continue
+        exp = expected_for(row, scn)
+        if backend == "spec":
+            obs = probe_spec(scn, sem)
+        elif backend == "jax":
+            obs = prober.probe(scn)
+        elif backend == "native":
+            obs = probe_native(scn, sem)
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
+        diffs.extend(_diff_observed("/".join(row.key), exp, obs))
+    return diffs
+
+
+def extract_and_diff(
+    sem: Semantics, backends: Sequence[str]
+) -> Dict[str, List[str]]:
+    table = build_table(sem)
+    return {b: diff_backend(table, b) for b in backends}

@@ -1,0 +1,378 @@
+"""AST lint for JAX pitfalls and dead spec handlers.
+
+Four rules, all tuned to be zero-finding on clean engine code:
+
+* **traced-branch** — a Python ``if``/``while``/``assert``/ternary in a
+  JAX op module whose test reads a value derived from a ``SimState``
+  parameter.  Under ``jit`` such a branch either crashes
+  (ConcretizationTypeError) or, worse, bakes in the tracer's abstract
+  truthiness; data-dependent control flow must go through
+  ``jnp.where``/``lax.select``.  Static facts (``.shape``/``.dtype``/
+  ``.ndim``/``is None``) are exempt.
+* **nondeterminism** — wall-clock or unseeded randomness in an engine
+  path (``models/``, ``ops/``): ``time.*``, module-level ``random.*``,
+  ``np.random.*``, ``datetime.now``.  The simulator's claim is
+  bit-reproducibility; the reference's thread-timing nondeterminism is
+  exactly what this rebuild removed.  Seeded ``random.Random(seed)``
+  instances and keyed ``jax.random.*`` are allowed — both are
+  deterministic functions of a recorded seed.
+* **dtype-drift** — 64-bit JAX dtypes (``jnp.int64`` & co) or
+  platform-width ``dtype=int``/``astype(int)`` in op modules.  With
+  ``jax_enable_x64`` off these silently narrow to 32 bits, so the code
+  computes in a different width than it names.  Host-side ``np.int64``
+  is fine (and used deliberately for trace packing).
+* **dead-handler** — ``spec_engine.py``'s ``_on_*`` methods must all be
+  registered in the ``_DISPATCH`` map, every registration must resolve
+  to a real method, and every ``MsgType`` must be dispatched.  An
+  unregistered handler is dead code that *looks* like protocol
+  coverage.
+
+CLI: ``python -m hpa2_tpu.analysis lint`` (a tier-1 test runs it).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable, List, Optional, Set
+
+#: directories (repo-relative) whose files are engine paths
+ENGINE_DIRS = (os.path.join("hpa2_tpu", "models"), os.path.join("hpa2_tpu", "ops"))
+#: op modules additionally subject to traced-branch and dtype-drift
+OPS_DIR = os.path.join("hpa2_tpu", "ops")
+
+#: parameter names / annotations treated as traced state roots
+STATE_PARAM_NAMES = {"st", "state", "sim_state", "nxt", "prev_state"}
+STATE_ANNOTATIONS = {"SimState"}
+#: attribute leaves that are static under jit (safe to branch on)
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
+
+JNP_ALIASES = {"jnp", "jax.numpy"}
+WIDE_DTYPES = {"int64", "float64", "uint64"}
+
+
+@dataclasses.dataclass(frozen=True)
+class LintFinding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _attr_root(node: ast.AST) -> Optional[str]:
+    """Root Name id of an attribute/subscript chain, else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_static_read(node: ast.AST) -> bool:
+    """True if the expression only reads static array facts."""
+    return isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS
+
+
+# ---------------------------------------------------------------------------
+# traced-branch
+# ---------------------------------------------------------------------------
+
+
+class _TracedBranchVisitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[LintFinding] = []
+
+    def visit_FunctionDef(self, fn: ast.FunctionDef) -> None:
+        tainted = self._state_params(fn)
+        if tainted:
+            self._scan_function(fn, tainted)
+        self.generic_visit(fn)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @staticmethod
+    def _state_params(fn: ast.FunctionDef) -> Set[str]:
+        out: Set[str] = set()
+        for arg in fn.args.args + fn.args.kwonlyargs:
+            ann = arg.annotation
+            ann_name = ""
+            if isinstance(ann, ast.Name):
+                ann_name = ann.id
+            elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                ann_name = ann.value
+            if arg.arg in STATE_PARAM_NAMES or ann_name in STATE_ANNOTATIONS:
+                out.add(arg.arg)
+        return out
+
+    def _scan_function(self, fn: ast.FunctionDef, tainted: Set[str]) -> None:
+        # single forward pass: names assigned from tainted expressions
+        # join the taint set (good enough for straight-line op code)
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) and self._reads_taint(
+                stmt.value, tainted
+            ):
+                for tgt in stmt.targets:
+                    for name in ast.walk(tgt):
+                        if isinstance(name, ast.Name):
+                            tainted.add(name.id)
+        for node in ast.walk(fn):
+            test = None
+            kind = None
+            if isinstance(node, (ast.If, ast.While)):
+                test, kind = node.test, type(node).__name__.lower()
+            elif isinstance(node, ast.IfExp):
+                test, kind = node.test, "ternary"
+            elif isinstance(node, ast.Assert):
+                test, kind = node.test, "assert"
+            if test is not None and self._reads_taint(test, tainted):
+                self.findings.append(LintFinding(
+                    "traced-branch", self.path, node.lineno,
+                    f"Python {kind} on a value derived from traced "
+                    f"SimState — under jit this is a concretization "
+                    f"error; use jnp.where/lax.select"))
+
+    @classmethod
+    def _reads_taint(cls, expr: ast.AST, tainted: Set[str]) -> bool:
+        # `x is None` / `x is not None` checks identity of the pytree
+        # object itself — static under jit
+        if isinstance(expr, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops
+        ):
+            return False
+        # an explicit bool()/int()/float() cast is deliberate host-side
+        # concretization: under a tracer it raises loudly at the cast,
+        # the silent footgun this rule exists for is the bare read
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+                and expr.func.id in ("bool", "int", "float"):
+            return False
+        if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+            return cls._reads_taint(expr.operand, tainted)
+        if isinstance(expr, ast.BoolOp):
+            return any(cls._reads_taint(v, tainted) for v in expr.values)
+        for node in ast.walk(expr):
+            if _is_static_read(node):
+                continue
+            if isinstance(node, ast.Name) and node.id in tainted:
+                # direct bare use of the pytree object (truthiness of
+                # the NamedTuple) is fine; attribute reads are not
+                continue
+            if isinstance(node, (ast.Attribute, ast.Subscript)):
+                if _is_static_read(node):
+                    continue
+                root = _attr_root(node)
+                if root in tainted:
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# nondeterminism
+# ---------------------------------------------------------------------------
+
+_BANNED_CALLS = {
+    ("time", "time"), ("time", "perf_counter"), ("time", "monotonic"),
+    ("time", "time_ns"), ("time", "sleep"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+    ("os", "urandom"), ("uuid", "uuid4"), ("uuid", "uuid1"),
+}
+
+
+class _NondeterminismVisitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[LintFinding] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            parent = f.value
+            if isinstance(parent, ast.Name):
+                pair = (parent.id, f.attr)
+                if pair in _BANNED_CALLS:
+                    self.findings.append(LintFinding(
+                        "nondeterminism", self.path, node.lineno,
+                        f"{parent.id}.{f.attr}() in an engine path — "
+                        f"simulation results must be a pure function of "
+                        f"config + traces + seed"))
+                elif parent.id == "random" and f.attr != "Random":
+                    # module-level random.* shares hidden global state;
+                    # a seeded random.Random(seed) instance is fine
+                    self.findings.append(LintFinding(
+                        "nondeterminism", self.path, node.lineno,
+                        f"module-level random.{f.attr}() — use a seeded "
+                        f"random.Random(seed) instance"))
+            elif (isinstance(parent, ast.Attribute)
+                  and isinstance(parent.value, ast.Name)):
+                if (parent.value.id in ("np", "numpy")
+                        and parent.attr == "random"):
+                    self.findings.append(LintFinding(
+                        "nondeterminism", self.path, node.lineno,
+                        f"np.random.{f.attr}() uses the hidden global "
+                        f"RNG — thread a seeded generator instead"))
+                if (parent.value.id == "datetime"
+                        and f.attr in ("now", "utcnow", "today")):
+                    self.findings.append(LintFinding(
+                        "nondeterminism", self.path, node.lineno,
+                        f"datetime.{parent.attr}.{f.attr}() in an "
+                        f"engine path"))
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# dtype-drift
+# ---------------------------------------------------------------------------
+
+
+class _DtypeDriftVisitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[LintFinding] = []
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in WIDE_DTYPES:
+            root = node.value
+            name = root.id if isinstance(root, ast.Name) else None
+            if name in JNP_ALIASES:
+                self.findings.append(LintFinding(
+                    "dtype-drift", self.path, node.lineno,
+                    f"jnp.{node.attr} silently narrows to 32 bits when "
+                    f"jax_enable_x64 is off — name the width you get"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        for kw in node.keywords:
+            if kw.arg == "dtype" and isinstance(kw.value, ast.Name) \
+                    and kw.value.id in ("int", "float"):
+                self.findings.append(LintFinding(
+                    "dtype-drift", self.path, node.lineno,
+                    f"dtype={kw.value.id} is platform-width — spell "
+                    f"out the 32-bit dtype"))
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "astype" \
+                and node.args and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id in ("int", "float"):
+            self.findings.append(LintFinding(
+                "dtype-drift", self.path, node.lineno,
+                f"astype({node.args[0].id}) is platform-width — spell "
+                f"out the 32-bit dtype"))
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# dead-handler (spec_engine dispatch registration)
+# ---------------------------------------------------------------------------
+
+
+def _lint_dispatch(path: str, tree: ast.Module) -> List[LintFinding]:
+    findings: List[LintFinding] = []
+    for cls in tree.body:
+        if not (isinstance(cls, ast.ClassDef) and cls.name == "SpecEngine"):
+            continue
+        handlers = {
+            m.name for m in cls.body
+            if isinstance(m, ast.FunctionDef) and m.name.startswith("_on_")
+        }
+        registered: Set[str] = set()
+        dispatched_types: Set[str] = set()
+        dispatch_line = cls.lineno
+        for item in cls.body:
+            if isinstance(item, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "_DISPATCH"
+                for t in item.targets
+            ) and isinstance(item.value, ast.Dict):
+                dispatch_line = item.lineno
+                for k, v in zip(item.value.keys, item.value.values):
+                    if isinstance(v, ast.Constant) and isinstance(
+                        v.value, str
+                    ):
+                        registered.add(v.value)
+                    if isinstance(k, ast.Attribute):
+                        dispatched_types.add(k.attr)
+        if not registered:
+            findings.append(LintFinding(
+                "dead-handler", path, dispatch_line,
+                "SpecEngine has no _DISPATCH dict literal — handler "
+                "registration is not statically checkable"))
+            continue
+        for h in sorted(handlers - registered):
+            findings.append(LintFinding(
+                "dead-handler", path, dispatch_line,
+                f"handler method {h} is not registered in _DISPATCH — "
+                f"dead code that looks like protocol coverage"))
+        for r in sorted(registered - handlers):
+            findings.append(LintFinding(
+                "dead-handler", path, dispatch_line,
+                f"_DISPATCH registers {r} but SpecEngine defines no "
+                f"such method"))
+        try:
+            from hpa2_tpu.models.protocol import MsgType
+            missing = {m.name for m in MsgType} - dispatched_types
+        except Exception:  # pragma: no cover — protocol must import
+            missing = set()
+        for m in sorted(missing):
+            findings.append(LintFinding(
+                "dead-handler", path, dispatch_line,
+                f"MsgType.{m} has no _DISPATCH entry — the message "
+                f"would hit the unknown-type assertion at runtime"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def _is_engine_path(rel: str) -> bool:
+    return any(rel.startswith(d + os.sep) for d in ENGINE_DIRS)
+
+
+def _is_ops_path(rel: str) -> bool:
+    return rel.startswith(OPS_DIR + os.sep)
+
+
+def lint_file(repo_root: str, rel: str) -> List[LintFinding]:
+    path = os.path.join(repo_root, rel)
+    with open(path, "r") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [LintFinding("parse", rel, e.lineno or 0, str(e))]
+    findings: List[LintFinding] = []
+    if _is_engine_path(rel):
+        v = _NondeterminismVisitor(rel)
+        v.visit(tree)
+        findings.extend(v.findings)
+    if _is_ops_path(rel):
+        tb = _TracedBranchVisitor(rel)
+        tb.visit(tree)
+        findings.extend(tb.findings)
+        dd = _DtypeDriftVisitor(rel)
+        dd.visit(tree)
+        findings.extend(dd.findings)
+    if rel.endswith(os.path.join("models", "spec_engine.py")):
+        findings.extend(_lint_dispatch(rel, tree))
+    return findings
+
+
+def default_targets(repo_root: str) -> List[str]:
+    out: List[str] = []
+    for d in ENGINE_DIRS:
+        full = os.path.join(repo_root, d)
+        for name in sorted(os.listdir(full)):
+            if name.endswith(".py"):
+                out.append(os.path.join(d, name))
+    return out
+
+
+def run_lint(repo_root: str, targets: Optional[Iterable[str]] = None
+             ) -> List[LintFinding]:
+    rels = list(targets) if targets is not None else default_targets(repo_root)
+    findings: List[LintFinding] = []
+    for rel in rels:
+        findings.extend(lint_file(repo_root, rel))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,169 @@
+"""Seeded table mutations: the analyzer's self-test.
+
+A static analyzer that has never been seen to *fail* proves nothing.
+Each mutation below injects one realistic protocol bug into the
+declarative table — the kinds of defect the reference implementation
+actually shipped (silently unhandled pairs, lost wakeups, wrong fill
+sources) — and the self-test asserts the analyzer catches every one,
+either statically (``run_static_checks`` errors) or by the spec
+equivalence diff.
+
+CLI: ``python -m hpa2_tpu.analysis mutation-test``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, NamedTuple
+
+from hpa2_tpu.config import Semantics
+from hpa2_tpu.analysis.table import Emit, Row, TransitionTable, build_table
+from hpa2_tpu.analysis.checks import run_static_checks
+from hpa2_tpu.analysis.extract import diff_backend
+
+
+class Mutation(NamedTuple):
+    name: str
+    description: str
+    apply: Callable[[TransitionTable], TransitionTable]
+
+
+def _swap(table: TransitionTable, key, **changes) -> TransitionTable:
+    old = table.row(*key)
+    return table.replaced(old, dataclasses.replace(old, **changes))
+
+
+def _delete(table: TransitionTable, key) -> TransitionTable:
+    old = table.row(*key)
+    return dataclasses.replace(
+        table, rows=[r for r in table.rows if r is not old]
+    )
+
+
+def _append(table: TransitionTable, row: Row) -> TransitionTable:
+    return dataclasses.replace(table, rows=list(table.rows) + [row])
+
+
+MUTATIONS: List[Mutation] = [
+    Mutation(
+        "swap-next-state",
+        "first read of an uncached block grants S instead of EM — the "
+        "directory forgets it has an exclusive owner",
+        lambda t: _swap(t, ("home", "U", "READ_REQUEST", "any"),
+                        next_state="S"),
+    ),
+    Mutation(
+        "delete-row",
+        "drop the cache-side INV/match row — invalidations are silently "
+        "ignored and stale lines survive",
+        lambda t: _delete(t, ("cache", "S", "INV", "match")),
+    ),
+    Mutation(
+        "drop-emission",
+        "home handles a READ_REQUEST but never sends the REPLY_RD — "
+        "the requester waits forever",
+        lambda t: _swap(t, ("home", "U", "READ_REQUEST", "any"), emits=()),
+    ),
+    Mutation(
+        "remove-drop-citation",
+        "strip the policy citation from the stale-INV drop — the drop "
+        "becomes silent",
+        lambda t: _swap(t, ("cache", "S", "INV", "other"), drop=""),
+    ),
+    Mutation(
+        "duplicate-case",
+        "claim the same guard-case twice with different outcomes — the "
+        "transition relation becomes ambiguous",
+        lambda t: _append(
+            t, dataclasses.replace(
+                t.row("cache", "I", "REPLY_WR", "any"), next_state="E")),
+    ),
+    Mutation(
+        "wrong-receiver",
+        "send the read reply to the current owner instead of the "
+        "requester",
+        lambda t: _swap(t, ("home", "U", "READ_REQUEST", "any"),
+                        emits=(Emit("REPLY_RD", "owner", value="mem",
+                                    sharers="excl"),)),
+    ),
+    Mutation(
+        "corrupt-sharers",
+        "FLUSH_INVACK leaves the directory EM with an empty sharer set "
+        "— an owned block with no owner",
+        lambda t: _swap(t, ("home", "EM", "FLUSH_INVACK", "any"),
+                        sharers="empty"),
+    ),
+    Mutation(
+        "premature-modified",
+        "an exclusive read fill installs M instead of E — a clean line "
+        "the directory will now ask to flush",
+        lambda t: _swap(t, ("cache", "I", "REPLY_RD", "excl"),
+                        next_state="M"),
+    ),
+    Mutation(
+        "phantom-emission",
+        "the write fill also broadcasts a spurious INV",
+        lambda t: _swap(
+            t, ("cache", "I", "REPLY_WR", "any"),
+            emits=(Emit("INV", "home"),)),
+    ),
+    Mutation(
+        "wrong-fill-source",
+        "REPLY_WR fills the line from the (stale) message payload "
+        "instead of the requester's pending write",
+        lambda t: _swap(t, ("cache", "I", "REPLY_WR", "any"),
+                        value_src="msg"),
+    ),
+    Mutation(
+        "contradict-unreachable",
+        "add a row in a cell explicitly declared unreachable",
+        lambda t: _append(
+            t, Row("home", "U", "NACK", "read_intervention",
+                   next_state="U")),
+    ),
+    Mutation(
+        "lost-wakeup",
+        "REPLY_WR fills the line but never clears the waiting flag — "
+        "the classic lost-wakeup hang",
+        lambda t: _swap(t, ("cache", "I", "REPLY_WR", "any"),
+                        clears_waiting=False),
+    ),
+]
+
+
+@dataclasses.dataclass
+class MutationResult:
+    name: str
+    caught: bool
+    caught_by: str       # 'static' | 'spec-diff' | ''
+    evidence: List[str]  # first few findings / diff lines
+
+
+def run_mutation(mut: Mutation, sem: Semantics) -> MutationResult:
+    table = mut.apply(build_table(sem))
+    static_errors = [
+        str(f) for f in run_static_checks(table) if f.severity == "error"
+    ]
+    if static_errors:
+        return MutationResult(mut.name, True, "static", static_errors[:3])
+    # statically plausible table — the behavioral diff must object
+    mutated_keys = _changed_keys(build_table(sem), table)
+    rows = [r for r in table.rows
+            if r.key in mutated_keys and not table.is_unreachable(*r.key)]
+    diffs = diff_backend(table, "spec", rows=rows or None)
+    if diffs:
+        return MutationResult(mut.name, True, "spec-diff", diffs[:3])
+    return MutationResult(mut.name, False, "", [])
+
+
+def _changed_keys(base: TransitionTable, mutated: TransitionTable):
+    base_rows = {r.key: r for r in base.rows}
+    return {
+        r.key for r in mutated.rows
+        if base_rows.get(r.key) != r
+    }
+
+
+def run_all_mutations(sem: Semantics = None) -> List[MutationResult]:
+    sem = sem if sem is not None else Semantics()
+    return [run_mutation(m, sem) for m in MUTATIONS]

@@ -372,53 +372,126 @@ class SpecEngine:
             )
 
     # -- protocol handler (assignment.c:187-566) ----------------------
+    #
+    # One method per message type, dispatched through _DISPATCH.  The
+    # map is the runtime mirror of the declarative transition table in
+    # hpa2_tpu.analysis.table — the static analyzer probes each method
+    # through _handle and diffs the observed transitions against the
+    # table, and the dead-handler lint checks every _on_* method is
+    # reachable from here.
+
+    _DISPATCH = {
+        MsgType.READ_REQUEST: "_on_read_request",
+        MsgType.WRITE_REQUEST: "_on_write_request",
+        MsgType.REPLY_RD: "_on_reply_rd",
+        MsgType.REPLY_WR: "_on_reply_wr",
+        MsgType.REPLY_ID: "_on_reply_id",
+        MsgType.INV: "_on_inv",
+        MsgType.UPGRADE: "_on_upgrade",
+        MsgType.WRITEBACK_INV: "_on_writeback_inv",
+        MsgType.WRITEBACK_INT: "_on_writeback_int",
+        MsgType.FLUSH: "_on_flush",
+        MsgType.FLUSH_INVACK: "_on_flush_invack",
+        MsgType.EVICT_SHARED: "_on_evict_shared",
+        MsgType.EVICT_MODIFIED: "_on_evict_modified",
+        MsgType.UPGRADE_NOTIFY: "_on_upgrade_notify",
+        MsgType.NACK: "_on_nack",
+    }
 
     def _handle(self, node: Node, msg: Message) -> None:
+        name = self._DISPATCH.get(msg.type)
+        if name is None:
+            raise AssertionError(f"unknown message type {msg.type}")
         cfg = self.config
-        sem = self.sem
         home = cfg.home_of(msg.address)
         blk = cfg.block_of(msg.address)
         line = node.line_for(msg.address)
         dir_entry = node.directory[blk] if node.id == home else None
-        t = msg.type
-        PH = 0  # handle phase
+        getattr(self, name)(node, msg, home, blk, line, dir_entry)
 
-        if t == MsgType.READ_REQUEST:
-            assert dir_entry is not None, "READ_REQUEST must arrive at home"
-            reply = Message(
-                MsgType.REPLY_RD, node.id, msg.address,
-                value=node.memory[blk], sharers=REPLY_RD_SHARED,
-            )
-            if dir_entry.state == DirState.U:
-                dir_entry.state = DirState.EM
-                dir_entry.sharers = bit(msg.sender)
+    def _on_read_request(self, node, msg, home, blk, line, dir_entry):
+        PH = 0
+        assert dir_entry is not None, "READ_REQUEST must arrive at home"
+        reply = Message(
+            MsgType.REPLY_RD, node.id, msg.address,
+            value=node.memory[blk], sharers=REPLY_RD_SHARED,
+        )
+        if dir_entry.state == DirState.U:
+            dir_entry.state = DirState.EM
+            dir_entry.sharers = bit(msg.sender)
+            reply.sharers = REPLY_RD_EXCLUSIVE
+            self._send(PH, msg.sender, reply)
+        elif dir_entry.state == DirState.S:
+            dir_entry.sharers |= bit(msg.sender)
+            reply.sharers = REPLY_RD_SHARED
+            self._send(PH, msg.sender, reply)
+        else:  # EM
+            owner = find_owner(dir_entry.sharers)
+            assert owner != -1
+            if owner == msg.sender:
+                # owner re-requesting (its copy was evicted-silently
+                # or lost): serve data, keep EM (assignment.c:215-221)
                 reply.sharers = REPLY_RD_EXCLUSIVE
                 self._send(PH, msg.sender, reply)
-            elif dir_entry.state == DirState.S:
+            else:
+                self._send(
+                    PH, owner,
+                    Message(
+                        MsgType.WRITEBACK_INT, node.id, msg.address,
+                        second_receiver=msg.sender,
+                    ),
+                )
+                # optimistic pre-flush transition (assignment.c:230-231)
+                dir_entry.state = DirState.S
                 dir_entry.sharers |= bit(msg.sender)
-                reply.sharers = REPLY_RD_SHARED
-                self._send(PH, msg.sender, reply)
-            else:  # EM
-                owner = find_owner(dir_entry.sharers)
-                assert owner != -1
-                if owner == msg.sender:
-                    # owner re-requesting (its copy was evicted-silently
-                    # or lost): serve data, keep EM (assignment.c:215-221)
-                    reply.sharers = REPLY_RD_EXCLUSIVE
-                    self._send(PH, msg.sender, reply)
-                else:
-                    self._send(
-                        PH, owner,
-                        Message(
-                            MsgType.WRITEBACK_INT, node.id, msg.address,
-                            second_receiver=msg.sender,
-                        ),
-                    )
-                    # optimistic pre-flush transition (assignment.c:230-231)
-                    dir_entry.state = DirState.S
-                    dir_entry.sharers |= bit(msg.sender)
 
-        elif t == MsgType.REPLY_RD:
+    def _on_reply_rd(self, node, msg, home, blk, line, dir_entry):
+        PH = 0
+        if (
+            line.address != INVALID_ADDR
+            and line.address != msg.address
+            and line.state != CacheState.INVALID
+        ):
+            self._replace(PH, node, line)
+        line.address = msg.address
+        line.value = msg.value
+        line.state = (
+            CacheState.EXCLUSIVE
+            if msg.sharers == REPLY_RD_EXCLUSIVE
+            else CacheState.SHARED
+        )
+        node.waiting = False
+
+    def _on_writeback_int(self, node, msg, home, blk, line, dir_entry):
+        PH = 0
+        if line.address == msg.address and line.state in (
+            CacheState.MODIFIED,
+            CacheState.EXCLUSIVE,
+        ):
+            flush = Message(
+                MsgType.FLUSH, node.id, msg.address,
+                value=line.value, second_receiver=msg.second_receiver,
+            )
+            self._send(PH, home, flush)
+            if msg.second_receiver != home:
+                self._send(PH, msg.second_receiver, flush.copy())
+            line.state = CacheState.SHARED
+        elif self.sem.intervention_miss_policy == "nack":
+            self._send(
+                PH, home,
+                Message(
+                    MsgType.NACK, node.id, msg.address,
+                    sharers=0,  # 0 = read intervention
+                    second_receiver=msg.second_receiver,
+                ),
+            )
+        # else: silent drop (assignment.c:265-270) — requester hangs
+
+    def _on_flush(self, node, msg, home, blk, line, dir_entry):
+        PH = 0
+        if node.id == home:
+            node.memory[blk] = msg.value
+        if node.id == msg.second_receiver:
             if (
                 line.address != INVALID_ADDR
                 and line.address != msg.address
@@ -427,278 +500,238 @@ class SpecEngine:
                 self._replace(PH, node, line)
             line.address = msg.address
             line.value = msg.value
-            line.state = (
-                CacheState.EXCLUSIVE
-                if msg.sharers == REPLY_RD_EXCLUSIVE
-                else CacheState.SHARED
+            line.state = CacheState.SHARED
+            node.waiting = False
+
+    def _on_upgrade(self, node, msg, home, blk, line, dir_entry):
+        PH = 0
+        assert dir_entry is not None, "UPGRADE must arrive at home"
+        if dir_entry.state == DirState.S:
+            self._send(
+                PH, msg.sender,
+                Message(
+                    MsgType.REPLY_ID, node.id, msg.address,
+                    sharers=dir_entry.sharers & ~bit(msg.sender),
+                ),
             )
-            node.waiting = False
+            dir_entry.state = DirState.EM
+            dir_entry.sharers = bit(msg.sender)
+        else:
+            # fallback: directory lost track (assignment.c:317-326)
+            dir_entry.state = DirState.EM
+            dir_entry.sharers = bit(msg.sender)
+            self._send(
+                PH, msg.sender,
+                Message(MsgType.REPLY_ID, node.id, msg.address, sharers=0),
+            )
 
-        elif t == MsgType.WRITEBACK_INT:
-            if line.address == msg.address and line.state in (
-                CacheState.MODIFIED,
-                CacheState.EXCLUSIVE,
-            ):
-                flush = Message(
-                    MsgType.FLUSH, node.id, msg.address,
-                    value=line.value, second_receiver=msg.second_receiver,
-                )
-                self._send(PH, home, flush)
-                if msg.second_receiver != home:
-                    self._send(PH, msg.second_receiver, flush.copy())
-                line.state = CacheState.SHARED
-            elif sem.intervention_miss_policy == "nack":
-                self._send(
-                    PH, home,
-                    Message(
-                        MsgType.NACK, node.id, msg.address,
-                        sharers=0,  # 0 = read intervention
-                        second_receiver=msg.second_receiver,
-                    ),
-                )
-            # else: silent drop (assignment.c:265-270) — requester hangs
+    def _on_reply_id(self, node, msg, home, blk, line, dir_entry):
+        PH = 0
+        fan_out = True
+        if line.address == msg.address and line.state != CacheState.MODIFIED:
+            line.value = node.pending_write
+            line.state = CacheState.MODIFIED
+        elif line.address == msg.address and line.state == CacheState.MODIFIED:
+            pass  # write already applied locally on the S-hit path
+        else:
+            # line was replaced while waiting: drop, no INVs
+            # (assignment.c:339-347)
+            fan_out = False
+        if fan_out:
+            for i in range(self.config.num_procs):
+                if i != node.id and is_bit_set(msg.sharers, i):
+                    self._send(
+                        PH, i, Message(MsgType.INV, node.id, msg.address)
+                    )
+        node.waiting = False
 
-        elif t == MsgType.FLUSH:
-            if node.id == home:
-                node.memory[blk] = msg.value
-            if node.id == msg.second_receiver:
-                if (
-                    line.address != INVALID_ADDR
-                    and line.address != msg.address
-                    and line.state != CacheState.INVALID
-                ):
-                    self._replace(PH, node, line)
-                line.address = msg.address
-                line.value = msg.value
-                line.state = CacheState.SHARED
-                node.waiting = False
+    def _on_inv(self, node, msg, home, blk, line, dir_entry):
+        if line.address == msg.address and line.state in (
+            CacheState.SHARED,
+            CacheState.EXCLUSIVE,
+        ):
+            line.state = CacheState.INVALID
+            self.counters["invalidations"] += 1
 
-        elif t == MsgType.UPGRADE:
-            assert dir_entry is not None, "UPGRADE must arrive at home"
-            if dir_entry.state == DirState.S:
-                self._send(
-                    PH, msg.sender,
-                    Message(
-                        MsgType.REPLY_ID, node.id, msg.address,
-                        sharers=dir_entry.sharers & ~bit(msg.sender),
-                    ),
-                )
-                dir_entry.state = DirState.EM
-                dir_entry.sharers = bit(msg.sender)
-            else:
-                # fallback: directory lost track (assignment.c:317-326)
-                dir_entry.state = DirState.EM
-                dir_entry.sharers = bit(msg.sender)
-                self._send(
-                    PH, msg.sender,
-                    Message(MsgType.REPLY_ID, node.id, msg.address, sharers=0),
-                )
-
-        elif t == MsgType.REPLY_ID:
-            fan_out = True
-            if line.address == msg.address and line.state != CacheState.MODIFIED:
-                line.value = node.pending_write
-                line.state = CacheState.MODIFIED
-            elif line.address == msg.address and line.state == CacheState.MODIFIED:
-                pass  # write already applied locally on the S-hit path
-            else:
-                # line was replaced while waiting: drop, no INVs
-                # (assignment.c:339-347)
-                fan_out = False
-            if fan_out:
-                for i in range(self.config.num_procs):
-                    if i != node.id and is_bit_set(msg.sharers, i):
-                        self._send(
-                            PH, i, Message(MsgType.INV, node.id, msg.address)
-                        )
-            node.waiting = False
-
-        elif t == MsgType.INV:
-            if line.address == msg.address and line.state in (
-                CacheState.SHARED,
-                CacheState.EXCLUSIVE,
-            ):
-                line.state = CacheState.INVALID
-                self.counters["invalidations"] += 1
-
-        elif t == MsgType.WRITE_REQUEST:
-            assert dir_entry is not None, "WRITE_REQUEST must arrive at home"
-            if sem.eager_write_request_memory:
-                # HEAD quirk (assignment.c:379); fixtures update memory
-                # only on FLUSH/FLUSH_INVACK/EVICT_MODIFIED
-                node.memory[blk] = msg.value
-            if dir_entry.state == DirState.U:
-                dir_entry.state = DirState.EM
-                dir_entry.sharers = bit(msg.sender)
+    def _on_write_request(self, node, msg, home, blk, line, dir_entry):
+        PH = 0
+        assert dir_entry is not None, "WRITE_REQUEST must arrive at home"
+        if self.sem.eager_write_request_memory:
+            # HEAD quirk (assignment.c:379); fixtures update memory
+            # only on FLUSH/FLUSH_INVACK/EVICT_MODIFIED
+            node.memory[blk] = msg.value
+        if dir_entry.state == DirState.U:
+            dir_entry.state = DirState.EM
+            dir_entry.sharers = bit(msg.sender)
+            self._send(
+                PH, msg.sender,
+                Message(MsgType.REPLY_WR, node.id, msg.address),
+            )
+        elif dir_entry.state == DirState.S:
+            self._send(
+                PH, msg.sender,
+                Message(
+                    MsgType.REPLY_ID, node.id, msg.address,
+                    sharers=dir_entry.sharers & ~bit(msg.sender),
+                ),
+            )
+            dir_entry.state = DirState.EM
+            dir_entry.sharers = bit(msg.sender)
+        else:  # EM
+            owner = find_owner(dir_entry.sharers)
+            assert owner != -1
+            if owner == msg.sender:
                 self._send(
                     PH, msg.sender,
                     Message(MsgType.REPLY_WR, node.id, msg.address),
                 )
-            elif dir_entry.state == DirState.S:
+            else:
                 self._send(
-                    PH, msg.sender,
+                    PH, owner,
                     Message(
-                        MsgType.REPLY_ID, node.id, msg.address,
-                        sharers=dir_entry.sharers & ~bit(msg.sender),
+                        MsgType.WRITEBACK_INV, node.id, msg.address,
+                        second_receiver=msg.sender,
                     ),
                 )
-                dir_entry.state = DirState.EM
+                # state stays EM; sharers optimistically = requester
+                # (assignment.c:429)
                 dir_entry.sharers = bit(msg.sender)
-            else:  # EM
-                owner = find_owner(dir_entry.sharers)
-                assert owner != -1
-                if owner == msg.sender:
-                    self._send(
-                        PH, msg.sender,
-                        Message(MsgType.REPLY_WR, node.id, msg.address),
-                    )
-                else:
-                    self._send(
-                        PH, owner,
-                        Message(
-                            MsgType.WRITEBACK_INV, node.id, msg.address,
-                            second_receiver=msg.sender,
-                        ),
-                    )
-                    # state stays EM; sharers optimistically = requester
-                    # (assignment.c:429)
-                    dir_entry.sharers = bit(msg.sender)
 
-        elif t == MsgType.REPLY_WR:
+    def _on_reply_wr(self, node, msg, home, blk, line, dir_entry):
+        assert (
+            line.address == msg.address
+            or line.address == INVALID_ADDR
+            or line.state == CacheState.INVALID
+        ), "REPLY_WR arrived but the slot holds another valid line"
+        line.address = msg.address
+        line.value = node.pending_write
+        line.state = CacheState.MODIFIED
+        node.waiting = False
+
+    def _on_writeback_inv(self, node, msg, home, blk, line, dir_entry):
+        PH = 0
+        if line.address == msg.address and line.state in (
+            CacheState.MODIFIED,
+            CacheState.EXCLUSIVE,
+        ):
+            ack = Message(
+                MsgType.FLUSH_INVACK, node.id, msg.address,
+                value=line.value, second_receiver=msg.second_receiver,
+            )
+            self._send(PH, home, ack)
+            if msg.second_receiver != home:
+                self._send(PH, msg.second_receiver, ack.copy())
+            line.state = CacheState.INVALID
+        elif self.sem.intervention_miss_policy == "nack":
+            self._send(
+                PH, home,
+                Message(
+                    MsgType.NACK, node.id, msg.address,
+                    sharers=1,  # 1 = write intervention
+                    second_receiver=msg.second_receiver,
+                ),
+            )
+        # else: silent drop (assignment.c:467-472)
+
+    def _on_flush_invack(self, node, msg, home, blk, line, dir_entry):
+        if node.id == home:
+            assert dir_entry is not None
+            node.memory[blk] = msg.value
+            dir_entry.state = DirState.EM
+            dir_entry.sharers = bit(msg.second_receiver)
+        if node.id == msg.second_receiver:
             assert (
                 line.address == msg.address
                 or line.address == INVALID_ADDR
                 or line.state == CacheState.INVALID
-            ), "REPLY_WR arrived but the slot holds another valid line"
+            ), "FLUSH_INVACK arrived but the slot holds another valid line"
             line.address = msg.address
-            line.value = node.pending_write
+            # fixtures: the requester's own pending write survives;
+            # HEAD installs the flushed old value (SURVEY.md §6.2.3)
+            line.value = (
+                msg.value
+                if self.sem.flush_invack_fills_old_value
+                else node.pending_write
+            )
             line.state = CacheState.MODIFIED
             node.waiting = False
 
-        elif t == MsgType.WRITEBACK_INV:
-            if line.address == msg.address and line.state in (
-                CacheState.MODIFIED,
-                CacheState.EXCLUSIVE,
-            ):
-                ack = Message(
-                    MsgType.FLUSH_INVACK, node.id, msg.address,
-                    value=line.value, second_receiver=msg.second_receiver,
-                )
-                self._send(PH, home, ack)
-                if msg.second_receiver != home:
-                    self._send(PH, msg.second_receiver, ack.copy())
-                line.state = CacheState.INVALID
-            elif sem.intervention_miss_policy == "nack":
-                self._send(
-                    PH, home,
-                    Message(
-                        MsgType.NACK, node.id, msg.address,
-                        sharers=1,  # 1 = write intervention
-                        second_receiver=msg.second_receiver,
-                    ),
-                )
-            # else: silent drop (assignment.c:467-472)
-
-        elif t == MsgType.FLUSH_INVACK:
-            if node.id == home:
-                assert dir_entry is not None
-                node.memory[blk] = msg.value
-                dir_entry.state = DirState.EM
-                dir_entry.sharers = bit(msg.second_receiver)
-            if node.id == msg.second_receiver:
-                assert (
-                    line.address == msg.address
-                    or line.address == INVALID_ADDR
-                    or line.state == CacheState.INVALID
-                ), "FLUSH_INVACK arrived but the slot holds another valid line"
-                line.address = msg.address
-                # fixtures: the requester's own pending write survives;
-                # HEAD installs the flushed old value (SURVEY.md §6.2.3)
-                line.value = (
-                    msg.value
-                    if sem.flush_invack_fills_old_value
-                    else node.pending_write
-                )
-                line.state = CacheState.MODIFIED
-                node.waiting = False
-
-        elif t == MsgType.EVICT_SHARED:
-            if node.id == home:
-                assert dir_entry is not None
-                if is_bit_set(dir_entry.sharers, msg.sender):
-                    dir_entry.sharers &= ~bit(msg.sender)
-                    remaining = count_sharers(dir_entry.sharers)
-                    if remaining == 0:
-                        dir_entry.state = DirState.U
-                    elif remaining == 1 and dir_entry.state == DirState.S:
-                        dir_entry.state = DirState.EM
-                        survivor = find_owner(dir_entry.sharers)
-                        notify_type = (
-                            MsgType.EVICT_SHARED
-                            if sem.overloaded_evict_shared_notify
-                            else MsgType.UPGRADE_NOTIFY
-                        )
-                        self._send(
-                            PH, survivor,
-                            Message(notify_type, node.id, msg.address),
-                        )
-            elif sem.overloaded_evict_shared_notify:
-                # HEAD's overloaded upgrade-notify (assignment.c:522-538)
-                if msg.sender == home:
-                    if (
-                        line.address == msg.address
-                        and line.state == CacheState.SHARED
-                    ):
-                        line.state = CacheState.EXCLUSIVE
-            # else: a non-home EVICT_SHARED cannot occur in fixture
-            # semantics (the notify is UPGRADE_NOTIFY)
-
-        elif t == MsgType.UPGRADE_NOTIFY:
-            # home -> surviving sharer: your S copy is now E.  Distinct
-            # type fixes the home-is-a-sharer livelock (SURVEY.md §6.3);
-            # the home itself receives it through its own mailbox too.
+    def _on_evict_shared(self, node, msg, home, blk, line, dir_entry):
+        PH = 0
+        if node.id == home:
+            assert dir_entry is not None
+            if is_bit_set(dir_entry.sharers, msg.sender):
+                dir_entry.sharers &= ~bit(msg.sender)
+                remaining = count_sharers(dir_entry.sharers)
+                if remaining == 0:
+                    dir_entry.state = DirState.U
+                elif remaining == 1 and dir_entry.state == DirState.S:
+                    dir_entry.state = DirState.EM
+                    survivor = find_owner(dir_entry.sharers)
+                    notify_type = (
+                        MsgType.EVICT_SHARED
+                        if self.sem.overloaded_evict_shared_notify
+                        else MsgType.UPGRADE_NOTIFY
+                    )
+                    self._send(
+                        PH, survivor,
+                        Message(notify_type, node.id, msg.address),
+                    )
+        elif self.sem.overloaded_evict_shared_notify:
+            # HEAD's overloaded upgrade-notify (assignment.c:522-538)
             if msg.sender == home:
-                if line.address == msg.address and line.state == CacheState.SHARED:
+                if (
+                    line.address == msg.address
+                    and line.state == CacheState.SHARED
+                ):
                     line.state = CacheState.EXCLUSIVE
+        # else: a non-home EVICT_SHARED cannot occur in fixture
+        # semantics (the notify is UPGRADE_NOTIFY)
 
-        elif t == MsgType.EVICT_MODIFIED:
-            assert dir_entry is not None, "EVICT_MODIFIED must arrive at home"
-            node.memory[blk] = msg.value
-            if dir_entry.state == DirState.EM and is_bit_set(
-                dir_entry.sharers, msg.sender
-            ):
-                dir_entry.sharers = 0
-                dir_entry.state = DirState.U
-            # else: stale eviction — release-build HEAD leaves the
-            # directory untouched (recovery exists only under DEBUG_MSG,
-            # assignment.c:548-560)
+    def _on_upgrade_notify(self, node, msg, home, blk, line, dir_entry):
+        # home -> surviving sharer: your S copy is now E.  Distinct
+        # type fixes the home-is-a-sharer livelock (SURVEY.md §6.3);
+        # the home itself receives it through its own mailbox too.
+        if msg.sender == home:
+            if line.address == msg.address and line.state == CacheState.SHARED:
+                line.state = CacheState.EXCLUSIVE
 
-        elif t == MsgType.NACK:
-            # robust mode only: re-serve the original request from
-            # memory.  The stale owner no longer holds the line, so the
-            # home can satisfy the requester directly.
-            assert dir_entry is not None, "NACK must arrive at home"
-            requester = msg.second_receiver
-            if msg.sharers == 0:  # read
-                dir_entry.state = DirState.S
-                dir_entry.sharers |= bit(requester)
-                self._send(
-                    PH, requester,
-                    Message(
-                        MsgType.REPLY_RD, node.id, msg.address,
-                        value=node.memory[blk], sharers=REPLY_RD_SHARED,
-                    ),
-                )
-            else:  # write
-                dir_entry.state = DirState.EM
-                dir_entry.sharers = bit(requester)
-                self._send(
-                    PH, requester,
-                    Message(MsgType.REPLY_WR, node.id, msg.address),
-                )
+    def _on_evict_modified(self, node, msg, home, blk, line, dir_entry):
+        assert dir_entry is not None, "EVICT_MODIFIED must arrive at home"
+        node.memory[blk] = msg.value
+        if dir_entry.state == DirState.EM and is_bit_set(
+            dir_entry.sharers, msg.sender
+        ):
+            dir_entry.sharers = 0
+            dir_entry.state = DirState.U
+        # else: stale eviction — release-build HEAD leaves the
+        # directory untouched (recovery exists only under DEBUG_MSG,
+        # assignment.c:548-560)
 
-        else:
-            raise AssertionError(f"unknown message type {t}")
+    def _on_nack(self, node, msg, home, blk, line, dir_entry):
+        # robust mode only: re-serve the original request from
+        # memory.  The stale owner no longer holds the line, so the
+        # home can satisfy the requester directly.
+        PH = 0
+        assert dir_entry is not None, "NACK must arrive at home"
+        requester = msg.second_receiver
+        if msg.sharers == 0:  # read
+            dir_entry.state = DirState.S
+            dir_entry.sharers |= bit(requester)
+            self._send(
+                PH, requester,
+                Message(
+                    MsgType.REPLY_RD, node.id, msg.address,
+                    value=node.memory[blk], sharers=REPLY_RD_SHARED,
+                ),
+            )
+        else:  # write
+            dir_entry.state = DirState.EM
+            dir_entry.sharers = bit(requester)
+            self._send(
+                PH, requester,
+                Message(MsgType.REPLY_WR, node.id, msg.address),
+            )
 
     # -- instruction issue (assignment.c:590-697) ---------------------
 

@@ -71,6 +71,12 @@ def _load():
             ctypes.c_int, ctypes.c_int, ctypes.c_ulonglong, ctypes.c_int,
             ctypes.c_int, ctypes.POINTER(Hpa2Result),
         ]
+        lib.hpa2_probe_transition.restype = ctypes.c_int
+        lib.hpa2_probe_transition.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+        ]
         _lib = lib
     return _lib
 
@@ -141,6 +147,31 @@ def run_trace_dir(
     if rc != 0 or not res.ok:
         raise NativeError(res.error.decode() or "native run failed")
     return res
+
+
+def probe_transition(config: SystemConfig, probe_in) -> list:
+    """Stage and run one transition on the native engine.
+
+    ``probe_in`` is the packed 22-slot scenario built by
+    ``hpa2_tpu.analysis.extract._native_packed``; the return value is
+    the raw output block (8 header slots + 5 per emission) that
+    ``extract.probe_native`` unpacks.  Used only by the static-analysis
+    cross-backend equivalence pass."""
+    _check_config(config)
+    lib = _load()
+    if len(probe_in) != 22:
+        raise NativeError(f"probe input must be 22 slots, got {len(probe_in)}")
+    in_arr = (ctypes.c_longlong * 22)(*probe_in)
+    out_cap = 8 + 5 * 8
+    out_arr = (ctypes.c_longlong * out_cap)()
+    rc = lib.hpa2_probe_transition(
+        config.num_procs, config.cache_size, config.mem_size,
+        config.msg_buffer_size, _sem_flags(config),
+        in_arr, out_arr, out_cap,
+    )
+    if rc != 0:
+        raise NativeError(f"native probe failed (rc={rc})")
+    return list(out_arr)
 
 
 def bench_random(

@@ -149,4 +149,24 @@ int hpa2_bench_random(int mode, int nodes, int cache, int mem, int cap,
   }
 }
 
+// Single-transition probe for the static-analysis equivalence pass
+// (hpa2_tpu/analysis/extract.py).  `probe_in` is the packed 22-slot
+// scenario; `probe_out` receives 8 header slots + 5 per emission.
+// Returns 0, -1 (bad receiver/index), or -2 (out_cap too small).
+int hpa2_probe_transition(int nodes, int cache, int mem, int cap,
+                          int sem_flags, const long long* probe_in,
+                          long long* probe_out, int out_cap) {
+  Config cfg;
+  cfg.nodes = nodes;
+  cfg.cache = cache;
+  cfg.mem = mem;
+  cfg.cap = cap;
+  apply_sem_flags(&cfg, sem_flags);
+  try {
+    return probe_transition(cfg, probe_in, probe_out, out_cap);
+  } catch (const std::exception&) {
+    return -3;
+  }
+}
+
 }  // extern "C"

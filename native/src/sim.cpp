@@ -9,10 +9,13 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <cassert>
 #include <cstdio>
 #include <cstring>
 #include <fstream>
+#include <mutex>
+#include <thread>
 #include <sstream>
 #include <stdexcept>
 
@@ -29,6 +32,12 @@ inline bool test_bit(Sharers s, int p) { return (s >> p) & 1; }
 inline int popcount(Sharers s) { return __builtin_popcountll(s); }
 inline int find_owner(Sharers s) {
   return s ? __builtin_ctzll(s) : -1;
+}
+
+inline double mono_seconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
 }
 
 struct NodeState {
@@ -454,6 +463,88 @@ void issue_one(const Config& cfg, int self, NodeState& n, SendFn&& send) {
 }  // namespace
 
 // ---------------------------------------------------------------------
+// Single-transition probe (analysis/extract.py cross-backend diff)
+// ---------------------------------------------------------------------
+//
+// Stages one node exactly as described by the packed `in` layout,
+// feeds it one message (handle_msg) or one instruction (issue_one),
+// and reports the node's post-state plus every emission.  The layout
+// is fixed by hpa2_tpu/analysis/extract.py:_native_packed — 22 input
+// slots; output is 8 header slots then 5 per emission.
+
+int probe_transition(const Config& cfg, const long long* in,
+                     long long* out, int out_cap) {
+  const int receiver = (int)in[0];
+  if (receiver < 0 || receiver >= cfg.nodes) return -1;
+  if (out_cap < 8) return -2;
+
+  std::vector<std::vector<Instr>> traces(cfg.nodes);
+  if (in[1]) {
+    Instr ins{};
+    ins.write = in[2] != 0;
+    ins.addr = (int32_t)in[3];
+    ins.value = (int32_t)in[4];
+    traces[receiver].push_back(ins);
+  }
+  std::vector<NodeState> nodes(cfg.nodes);
+  for (int i = 0; i < cfg.nodes; ++i) nodes[i].init(cfg, i, traces[i]);
+
+  NodeState& n = nodes[receiver];
+  const int li = (int)in[11];
+  if (li < 0 || li >= cfg.cache) return -1;
+  n.cache[li].addr = (int32_t)in[12];
+  n.cache[li].value = (int32_t)in[13];
+  n.cache[li].state = (CacheSt)(int8_t)in[14];
+  const int blk = (int)in[15];
+  const int mblk = (int)in[18];
+  if (blk < 0 || blk >= cfg.mem || mblk < 0 || mblk >= cfg.mem) return -1;
+  n.directory[blk].state = (DirSt)(int8_t)in[16];
+  n.directory[blk].sharers = (Sharers)in[17];
+  n.memory[mblk] = (int32_t)in[19];
+  n.pending = (int32_t)in[20];
+  n.waiting = in[21] != 0;
+
+  struct Emitted {
+    int recv;
+    Msg m;
+  };
+  std::vector<Emitted> emits;
+  auto send = [&](int recv, const Msg& m) { emits.push_back({recv, m}); };
+
+  if (in[1]) {
+    issue_one(cfg, receiver, n, send);
+  } else {
+    Msg msg{};
+    msg.type = (int8_t)in[5];
+    msg.sender = (int32_t)in[6];
+    msg.addr = (int32_t)in[7];
+    msg.value = (int32_t)in[8];
+    msg.sharers = (Sharers)in[9];
+    msg.second = (int32_t)in[10];
+    handle_msg(cfg, receiver, n, msg, send);
+  }
+
+  if (out_cap < 8 + 5 * (int)emits.size()) return -2;
+  out[0] = n.cache[li].addr;
+  out[1] = n.cache[li].value;
+  out[2] = (long long)n.cache[li].state;
+  out[3] = (long long)n.directory[blk].state;
+  out[4] = (long long)n.directory[blk].sharers;
+  out[5] = n.memory[mblk];
+  out[6] = n.waiting ? 1 : 0;
+  out[7] = (long long)emits.size();
+  for (size_t i = 0; i < emits.size(); ++i) {
+    long long* e = out + 8 + 5 * i;
+    e[0] = emits[i].recv;
+    e[1] = (long long)emits[i].m.type;
+    e[2] = emits[i].m.value;
+    e[3] = emits[i].m.second;
+    e[4] = (long long)emits[i].m.sharers;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------
 // Deterministic lockstep engine (spec_engine.SpecEngine.step)
 // ---------------------------------------------------------------------
 
@@ -653,7 +744,11 @@ namespace {
 struct RingBox {
   std::vector<Msg> ring;
   int head = 0, tail = 0, count = 0;
-  omp_lock_t lock;
+  // std::mutex (pthread-backed) rather than omp_lock_t: identical
+  // semantics/cost, but ThreadSanitizer intercepts pthread locks while
+  // an uninstrumented libgomp's locks are invisible to it — this keeps
+  // the engine race-checkable (make tsan)
+  std::mutex lock;
 };
 
 }  // namespace
@@ -669,7 +764,6 @@ RunResult run_omp(const Config& cfg,
   for (int i = 0; i < N; ++i) {
     nodes[i].init(cfg, i, traces[i]);
     box[i].ring.resize(cfg.cap);
-    omp_init_lock(&box[i].lock);
   }
   res.snapshots.resize(N);
   res.candidates.resize(N);
@@ -687,13 +781,11 @@ RunResult run_omp(const Config& cfg,
     for (auto& t : traces) total_instrs += t.size();
   std::vector<IssueRecord> order_buf(total_instrs);
   std::atomic<uint64_t> issue_seq{0};
-  omp_lock_t log_lock;
-  omp_init_lock(&log_lock);
+  std::mutex log_lock;
   auto log_line = [&](std::string s) {
     if (!trace_msgs) return;
-    omp_set_lock(&log_lock);
+    std::lock_guard<std::mutex> g(log_lock);
     res.msg_log.push_back(std::move(s));
-    omp_unset_lock(&log_lock);
   };
   std::atomic<bool> aborted{false};  // livelock watchdog (the
   // reference spins forever on this class; SURVEY.md §6.3).
@@ -706,13 +798,13 @@ RunResult run_omp(const Config& cfg,
     inflight.fetch_add(1, std::memory_order_relaxed);
     double spin_start = -1.0;
     for (;;) {
-      omp_set_lock(&box[recv].lock);
+      box[recv].lock.lock();
       if (box[recv].count < cfg.cap) break;
-      omp_unset_lock(&box[recv].lock);  // full: yield and retry (the
+      box[recv].lock.unlock();  // full: yield and retry (the
       // reference busy-waits with usleep, c:715-724)
       // watchdog: with tiny capacities blocked senders can deadlock
       // cyclically (the reference would spin forever here)
-      double now = omp_get_wtime();
+      double now = mono_seconds();
       if (spin_start < 0) spin_start = now;
       if (now - spin_start > kWatchdogSeconds)
         aborted.store(true, std::memory_order_relaxed);
@@ -728,20 +820,21 @@ RunResult run_omp(const Config& cfg,
     // log before releasing the box lock: the receiver cannot dequeue
     // until then, so every message's send line precedes its receive
     if (trace_msgs) log_line(fmt_msg_send(recv, m));
-    omp_unset_lock(&box[recv].lock);
+    box[recv].lock.unlock();
   };
 
   if (num_threads > N) num_threads = N;
   std::atomic<uint64_t> msg_total{0};
-  omp_set_num_threads(num_threads);
-#pragma omp parallel
-  {
+  // plain std::thread workers rather than a #pragma omp parallel
+  // region: identical pool semantics, but ThreadSanitizer intercepts
+  // pthread create/join while an uninstrumented libgomp's fork/join
+  // barriers are invisible to it — with OMP the *entire engine* reads
+  // as one big phantom race (make tsan would be useless)
+  auto worker = [&](int tid, int nt) {
     // each thread owns a contiguous block of nodes and round-robins
     // them: drain-then-issue per node, exactly the reference's loop
     // shape (assignment.c:153-699) but multiplexed so any thread
     // count (1..N) works and oversubscription degrades gracefully
-    const int tid = omp_get_thread_num();
-    const int nt = omp_get_num_threads();
     const int lo = (int)((int64_t)N * tid / nt);
     const int hi = (int)((int64_t)N * (tid + 1) / nt);
     std::vector<bool> counted_done(hi - lo, false);
@@ -761,15 +854,15 @@ RunResult run_omp(const Config& cfg,
         NodeState& nd = nodes[i];
         // drain mailbox
         for (;;) {
-          omp_set_lock(&box[i].lock);
+          box[i].lock.lock();
           if (box[i].count == 0) {
-            omp_unset_lock(&box[i].lock);
+            box[i].lock.unlock();
             break;
           }
           Msg m = box[i].ring[box[i].head];
           box[i].head = (box[i].head + 1) % cfg.cap;
           box[i].count--;
-          omp_unset_lock(&box[i].lock);
+          box[i].lock.unlock();
           if (trace_msgs) log_line(fmt_msg_recv(i, m));
           handle_msg(cfg, i, nd, m, csend);
           inflight.fetch_sub(1, std::memory_order_release);
@@ -810,7 +903,7 @@ RunResult run_omp(const Config& cfg,
       } else {
         // idle: let peers run (critical when oversubscribed) and
         // watchdog the reference's livelock class (SURVEY.md §6.3)
-        double now = omp_get_wtime();
+        double now = mono_seconds();
         if (idle_start < 0) idle_start = now;
         if (now - idle_start > kWatchdogSeconds) {
           aborted.store(true, std::memory_order_relaxed);
@@ -821,10 +914,13 @@ RunResult run_omp(const Config& cfg,
     }
     instr_total.fetch_add(my_instrs, std::memory_order_relaxed);
     msg_total.fetch_add(my_msgs, std::memory_order_relaxed);
-  }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 1; t < num_threads; ++t)
+    pool.emplace_back(worker, t, num_threads);
+  worker(0, num_threads);
+  for (auto& th : pool) th.join();
 
-  for (int i = 0; i < N; ++i) omp_destroy_lock(&box[i].lock);
-  omp_destroy_lock(&log_lock);
   if (record_order)
     res.issue_order.assign(order_buf.begin(),
                            order_buf.begin() + issue_seq.load());

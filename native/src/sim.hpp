@@ -178,4 +178,11 @@ std::vector<std::vector<Instr>> gen_uniform_random(const Config& cfg,
                                                    int instrs_per_core,
                                                    uint64_t seed);
 
+// Single-transition probe for the static-analysis cross-backend
+// equivalence pass (hpa2_tpu/analysis/extract.py).  `in` is the packed
+// 22-slot scenario; `out` receives 8 header slots + 5 per emission.
+// Returns 0, -1 (bad receiver/index), or -2 (out_cap too small).
+int probe_transition(const Config& cfg, const long long* in,
+                     long long* out, int out_cap);
+
 }  // namespace hpa2

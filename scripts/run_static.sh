@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Static analysis gate: declarative-table checks + spec equivalence,
+# the JAX-pitfall/dead-handler lint, the analyzer's mutation self-test,
+# and the ASan+UBSan smoke run of the native backend.
+#
+# The same checks also run inside tier-1 (tests/test_analysis.py,
+# tests/test_table_equivalence.py, tests/test_sanitizers.py); this
+# script is the fast standalone entry point — no JAX import, a few
+# seconds end to end.  Cross-backend equivalence including the JAX and
+# native engines: python -m hpa2_tpu.analysis equiv
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== analysis check (static table checks + spec equivalence) =="
+python -m hpa2_tpu.analysis check
+
+echo "== analysis lint (JAX pitfalls, dead handlers) =="
+python -m hpa2_tpu.analysis lint
+
+echo "== analyzer mutation self-test =="
+python -m hpa2_tpu.analysis mutation-test
+
+echo "== native ASan+UBSan smoke =="
+if make -C native asan >/dev/null 2>&1; then
+    ASAN_OPTIONS=detect_leaks=0:abort_on_error=1 \
+    UBSAN_OPTIONS=halt_on_error=1 \
+        ./native/build/hpa2sim_asan --bench 300 --robust --json
+else
+    echo "sanitizer toolchain unavailable; skipped"
+fi
+
+echo "STATIC_OK"

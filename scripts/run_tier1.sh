@@ -11,4 +11,12 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+# A file that fails to *collect* silently shrinks the pass count — the
+# run must fail even if every collected test passed and the pytest exit
+# code got rewritten somewhere (plugin, timeout, shell edge).  The
+# summary line reports "N error(s)" exactly when collection errored.
+if grep -aqE '(^|, )[0-9]+ errors? in [0-9]' /tmp/_t1.log; then
+    echo "TIER1: pytest reported collection errors; failing" >&2
+    [ "$rc" -eq 0 ] && rc=1
+fi
 exit $rc

@@ -15,6 +15,11 @@ def pytest_configure(config):
         "sweep: randomized cross-engine differential sweep "
         "(tests/test_random_differential.py)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (-m 'not slow'); e.g. the "
+        "TSan bench in tests/test_sanitizers.py",
+    )
     # The axon sitecustomize registers the TPU PJRT plugin at
     # interpreter startup and pins the backend, so an in-process
     # JAX_PLATFORMS override is too late — re-exec once with a clean

@@ -1,0 +1,129 @@
+"""Static analysis suite tests: table checks, lint, mutation self-test,
+and the analysis CLI.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from hpa2_tpu.config import Semantics
+from hpa2_tpu.analysis.table import CASE_UNIVERSE, build_table
+from hpa2_tpu.analysis.checks import run_static_checks
+from hpa2_tpu.analysis.lint import run_lint
+from hpa2_tpu.analysis.mutate import MUTATIONS, run_all_mutations
+
+SEMS = {
+    "default": Semantics(),
+    "robust": Semantics().robust(),
+    "head": Semantics().head_quirks(),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SEMS))
+def test_shipped_table_has_no_errors(name):
+    findings = run_static_checks(build_table(SEMS[name]))
+    errors = [f for f in findings if f.severity == "error"]
+    assert not errors, "\n".join(str(f) for f in errors)
+
+
+def test_drop_policy_warnings_are_the_only_warnings():
+    """Under the drop policy the reply chain visibly ends in documented
+    hangs — warnings, never errors; under nack there is nothing to
+    warn about."""
+    warn = [f for f in run_static_checks(build_table(SEMS["default"]))
+            if f.severity == "warning"]
+    assert warn and all(f.check == "reply-guarantee" for f in warn)
+    assert not [f for f in run_static_checks(build_table(SEMS["robust"]))
+                if f.severity == "warning"]
+
+
+def test_case_universe_is_semantics_invariant():
+    """Policy knobs change row *content*, never which guard-cases
+    exist — all variants tile the same universe."""
+    sizes = {
+        name: sum(
+            len(cases)
+            for per_state in CASE_UNIVERSE.values()
+            for cases in per_state.values()
+        )
+        for name in SEMS
+    }
+    assert len(set(sizes.values())) == 1
+    for name, sem in SEMS.items():
+        t = build_table(sem)
+        covered = {r.key for r in t.rows}
+        for (role, event), per_state in CASE_UNIVERSE.items():
+            for state, cases in per_state.items():
+                for case in cases:
+                    assert (role, state, event, case) in covered \
+                        or t.is_unreachable(role, state, event, case), \
+                        (name, role, state, event, case)
+
+
+def test_lint_clean_on_shipped_engine_code():
+    findings = run_lint(".")
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+def test_lint_catches_seeded_pitfalls(tmp_path):
+    bad = (
+        "import time, random\n"
+        "import jax.numpy as jnp\n"
+        "def step(st, config):\n"
+        "    if st.waiting[0]:\n"
+        "        pass\n"
+        "    t = time.time()\n"
+        "    x = random.randint(0, 3)\n"
+        "    y = jnp.zeros(4, dtype=jnp.int64)\n"
+        "    z = jnp.arange(4).astype(int)\n"
+    )
+    (tmp_path / "hpa2_tpu" / "ops").mkdir(parents=True)
+    (tmp_path / "hpa2_tpu" / "models").mkdir(parents=True)
+    (tmp_path / "hpa2_tpu" / "ops" / "bad.py").write_text(bad)
+    rules = {f.rule for f in run_lint(str(tmp_path))}
+    assert {"traced-branch", "nondeterminism", "dtype-drift"} <= rules
+
+
+def test_lint_dead_handler_detection(tmp_path):
+    """A handler missing from _DISPATCH and an unmapped MsgType must
+    both be flagged."""
+    import re
+
+    src = open("hpa2_tpu/models/spec_engine.py").read()
+    mutated, n = re.subn(r"MsgType\.NACK: \"_on_nack\",\n", "", src)
+    assert n == 1
+    (tmp_path / "hpa2_tpu" / "ops").mkdir(parents=True)
+    (tmp_path / "hpa2_tpu" / "models").mkdir(parents=True)
+    (tmp_path / "hpa2_tpu" / "models" / "spec_engine.py").write_text(mutated)
+    msgs = [f.message for f in run_lint(str(tmp_path))
+            if f.rule == "dead-handler"]
+    assert any("_on_nack" in m for m in msgs)
+    assert any("MsgType.NACK" in m for m in msgs)
+
+
+def test_every_seeded_mutation_is_caught():
+    results = run_all_mutations()
+    missed = [r.name for r in results if not r.caught]
+    assert not missed, f"analyzer missed mutations: {missed}"
+    assert len(results) == len(MUTATIONS) >= 10
+
+
+def test_mutations_exercise_both_catchers():
+    """The suite must prove both halves of the analyzer: some bugs are
+    only structural (static), some only behavioral (spec diff)."""
+    by = {r.caught_by for r in run_all_mutations()}
+    assert by == {"static", "spec-diff"}
+
+
+@pytest.mark.parametrize("argv,expect_rc", [
+    (["check"], 0),
+    (["lint"], 0),
+    (["mutation-test"], 0),
+])
+def test_cli_subcommands(argv, expect_rc):
+    proc = subprocess.run(
+        [sys.executable, "-m", "hpa2_tpu.analysis"] + argv,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == expect_rc, proc.stdout + proc.stderr

@@ -1,0 +1,100 @@
+"""Sanitizer smoke tests for the native backend.
+
+Runs the lockstep and OpenMP engines under ASan+UBSan (and the OMP
+engine under TSan when available) on a small synthetic workload.  The
+engines index by node id, cache line, and block from message fields in
+a hot loop — exactly the code a fuzzed or mutated message would push
+out of bounds — so a clean sanitizer pass is a real property, not a
+formality.
+
+Skips (never fails) when the sanitizer toolchain is unavailable: the
+compiler may lack libasan/libtsan in minimal containers.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+
+
+def _build(target: str, binary: str):
+    """Build a sanitizer binary; skip the test if the toolchain can't."""
+    proc = subprocess.run(
+        ["make", "-C", NATIVE_DIR, target],
+        capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        pytest.skip(f"sanitizer build unavailable: {proc.stderr[-300:]}")
+    path = os.path.join(NATIVE_DIR, "build", binary)
+    if not os.path.exists(path):
+        pytest.skip(f"sanitizer binary missing after build: {binary}")
+    return path
+
+
+def _run(binary: str, args, env_extra):
+    env = dict(os.environ)
+    env.update(env_extra)
+    proc = subprocess.run(
+        [binary] + args, capture_output=True, text=True, env=env,
+        timeout=300,
+    )
+    # a sanitizer report is always accompanied by a nonzero exit
+    # (abort_on_error / halt_on_error below), so rc==0 means clean
+    assert proc.returncode == 0, (
+        f"sanitizer run failed (rc={proc.returncode}):\n"
+        f"{proc.stdout[-1000:]}\n{proc.stderr[-2000:]}"
+    )
+
+
+@pytest.mark.parametrize("mode", ["lockstep", "omp"])
+def test_asan_ubsan_bench(mode):
+    binary = _build("asan", "hpa2sim_asan")
+    _run(
+        binary,
+        # --robust: the default drop policy faithfully livelocks on
+        # random workloads (its documented hang), which would hit the
+        # cycle budget rather than exercise the memory paths
+        ["--bench", "300", "--mode", mode, "--robust", "--json",
+         "--seed", "7"],
+        {
+            # libgomp's persistent thread pool reads as a leak; the
+            # target here is heap/stack corruption and UB, not leaks
+            "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
+            "UBSAN_OPTIONS": "halt_on_error=1:print_stacktrace=1",
+        },
+    )
+
+
+def test_asan_ubsan_robust_quirks():
+    """The quirk/robust code paths index differently (NACK re-serve,
+    overloaded notify) — cover them under the sanitizers too."""
+    binary = _build("asan", "hpa2sim_asan")
+    _run(
+        binary,
+        ["--bench", "200", "--robust", "--json"],
+        {"ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
+         "UBSAN_OPTIONS": "halt_on_error=1"},
+    )
+    # eager-write + flush-old-fill only: the overloaded-notify quirk
+    # faithfully livelocks on random workloads (SURVEY.md §6.3)
+    _run(
+        binary,
+        ["--bench", "200", "--robust", "--quirk", "eager-write",
+         "--quirk", "flush-old-fill", "--json"],
+        {"ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
+         "UBSAN_OPTIONS": "halt_on_error=1"},
+    )
+
+
+@pytest.mark.slow
+def test_tsan_omp_bench():
+    """TSan over the free-running OpenMP engine (ring mailboxes under
+    per-node locks).  Slow: TSan is a ~10x slowdown."""
+    binary = _build("tsan", "hpa2sim_tsan")
+    _run(
+        binary,
+        ["--bench", "200", "--mode", "omp", "--robust", "--json"],
+        {"TSAN_OPTIONS": "halt_on_error=1"},
+    )
