@@ -6,11 +6,14 @@ Subcommands::
     python -m hpa2_tpu.analysis lint           # JAX-pitfall / dead-handler lint
     python -m hpa2_tpu.analysis equiv          # cross-backend table diff
     python -m hpa2_tpu.analysis mutation-test  # analyzer self-test
+    python -m hpa2_tpu.analysis vmem           # static VMEM budget model
 
 ``check`` is the cheap gate (pure Python, no JAX import): whole-table
 static checks plus the spec-engine equivalence diff, on both the
-default and robust semantics.  ``equiv`` extends the diff to the JAX
-and native backends.  All subcommands exit non-zero on failure.
+default and robust semantics.  ``equiv`` extends the diff to the JAX,
+native, and Pallas (interpret-mode single-transition probes of the
+real kernel program) backends.  All subcommands exit non-zero on
+failure.
 """
 
 from __future__ import annotations
@@ -80,11 +83,12 @@ def cmd_equiv(args: argparse.Namespace) -> int:
         sem = _SEMS[name]()
         table = build_table(sem)
         for backend in args.backends:
-            if backend == "jax" and sem.overloaded_evict_shared_notify:
-                # the JAX backend refuses to build the overloaded
-                # notify quirk; nothing to extract
-                print(f"[{name}] jax: skipped (overloaded quirk "
-                      f"unsupported by the JAX backend)")
+            if (backend in ("jax", "pallas")
+                    and sem.overloaded_evict_shared_notify):
+                # the JAX and Pallas backends refuse to build the
+                # overloaded notify quirk; nothing to extract
+                print(f"[{name}] {backend}: skipped (overloaded quirk "
+                      f"unsupported by this backend)")
                 continue
             try:
                 diffs = diff_backend(table, backend)
@@ -98,6 +102,22 @@ def cmd_equiv(args: argparse.Namespace) -> int:
                 print(f"  {d}")
             total += len(diffs)
     return 1 if total else 0
+
+
+def cmd_vmem(args: argparse.Namespace) -> int:
+    from hpa2_tpu.config import SystemConfig
+    from hpa2_tpu.analysis.vmem import budget_table, vmem_budget
+
+    cfg = SystemConfig(
+        num_procs=args.procs, msg_buffer_size=args.cap,
+        semantics=_SEMS[args.sem[0]](),
+    )
+    blocks = tuple(int(b) for b in args.blocks.split(","))
+    print(budget_table(cfg, blocks, args.window,
+                       snapshots=args.snapshots, gate=args.gate))
+    worst = vmem_budget(cfg, max(blocks), args.window,
+                        snapshots=args.snapshots, gate=args.gate)
+    return 0 if worst.fits else 1
 
 
 def cmd_mutation_test(args: argparse.Namespace) -> int:
@@ -129,12 +149,21 @@ def main(argv=None) -> int:
     lp = sub.add_parser("lint", help="JAX-pitfall / dead-handler lint")
     lp.add_argument("--root", default=repo_root)
     ep = sub.add_parser("equiv", help="cross-backend table diff")
-    ep.add_argument("--backends", default="spec,jax,native",
-                    help="comma-separated: spec,jax,native")
+    ep.add_argument("--backends", default="spec,jax,native,pallas",
+                    help="comma-separated: spec,jax,native,pallas")
     ep.add_argument("--allow-missing-native", action="store_true",
                     help="skip (not fail) when the native build is "
                          "unavailable")
     sub.add_parser("mutation-test", help="analyzer self-test")
+    vp = sub.add_parser("vmem", help="static VMEM budget model")
+    vp.add_argument("--blocks", default="512,1024,2048",
+                    help="comma-separated block widths")
+    vp.add_argument("--window", type=int, default=32)
+    vp.add_argument("--procs", type=int, default=8)
+    vp.add_argument("--cap", type=int, default=16,
+                    help="mailbox capacity (msg_buffer_size)")
+    vp.add_argument("--snapshots", action="store_true")
+    vp.add_argument("--gate", action="store_true")
     args = p.parse_args(argv)
     args.sem = [s.strip() for s in args.sem.split(",") if s.strip()]
     for s in args.sem:
@@ -143,13 +172,14 @@ def main(argv=None) -> int:
     if hasattr(args, "backends"):
         args.backends = [b.strip() for b in args.backends.split(",")]
         for b in args.backends:
-            if b not in ("spec", "jax", "native"):
+            if b not in ("spec", "jax", "native", "pallas"):
                 p.error(f"unknown backend {b!r}")
     return {
         "check": cmd_check,
         "lint": cmd_lint,
         "equiv": cmd_equiv,
         "mutation-test": cmd_mutation_test,
+        "vmem": cmd_vmem,
     }[args.cmd](args)
 
 

@@ -13,6 +13,12 @@ the scenario set:
   end-of-cycle delivery (nobody else acts: empty traces, empty boxes).
 * **native** — the ``hpa2_probe_transition`` C API (a packed
   setup/observe probe added to ``capi.cpp`` for exactly this purpose).
+* **pallas** — ONE cycle of the real Pallas kernel program
+  (``_build_call`` at batch 1, block 1, k=1, gate off) run through
+  pallas interpret mode; the scenario is staged into the engine's
+  packed word planes and emissions read back out of the other nodes'
+  packed mailboxes, so the diff covers the word packing and the
+  candidate-grid delivery, not just the cycle math.
 
 Sentinel values make data-flow claims checkable: memory holds 77, the
 preloaded line 55, ``pending_write`` 66, the message payload 88, the
@@ -467,6 +473,150 @@ class JaxProber:
         ).normalized()
 
 
+class PallasProber:
+    """Single-transition probes against the Pallas engine.
+
+    Each probe stages the scenario directly into the kernel's packed
+    planes (cache word, directory word, scalar row, mailbox wire
+    words), runs exactly one cycle of the REAL kernel program —
+    ``_build_call`` at batch 1 / block 1 / ``k=1`` with the quiescence
+    gate off, lowered through pallas interpret mode — and decodes the
+    resulting planes back into an :class:`Observed`.  The builder's
+    ``lru_cache`` plus jit shape-caching mean one compile serves the
+    whole row set.
+
+    The ``aux`` wire union is type-dependent (value | excl flag for
+    REPLY_RD, the sharer/fan mask for REPLY_ID, the rd/wr flag for
+    NACK, the byte value otherwise); the stage/decode here mirrors the
+    kernel's own pack sites so a packing regression shows up as a
+    table diff naming the row."""
+
+    def __init__(self, sem: Semantics):
+        from hpa2_tpu.ops import pallas_engine as pe
+
+        self.pe = pe
+        self.cfg = SystemConfig(semantics=sem)
+        if pe._split_mode(self.cfg):
+            raise ValueError(
+                "probe geometry is the packed-word 4-node reference")
+        self.layout, self.W = pe._mb_layout(self.cfg)
+        # t_dim 1: one instruction slot, shared by msg probes
+        self.slsc = pe._scalar_layout(self.cfg, 1)
+        self.call = pe._build_call(
+            self.cfg, 1, 1, 1, True, False, frozenset(), False)
+
+    # -- wire-word helpers --------------------------------------------
+
+    def _dec(self, words: Sequence[int], name: str) -> int:
+        w, off, wd = self.layout[name]
+        return (words[w] >> off) & ((1 << wd) - 1)
+
+    def _msg_words(self, scn: Scenario) -> List[int]:
+        from hpa2_tpu.models.protocol import MsgType as MT
+
+        mt = scn.msg_type
+        if mt == int(MT.REPLY_RD):
+            aux = (scn.msg_value & 0xFF) | (
+                256 if scn.msg_sharers == 2 else 0)
+        elif mt in (int(MT.REPLY_ID), int(MT.NACK)):
+            aux = scn.msg_sharers
+        else:
+            aux = scn.msg_value & 0xFF
+        vals = {"type": mt, "sender": scn.msg_sender,
+                "second": scn.msg_second + 1, "addr": scn.msg_addr,
+                "aux": aux}
+        words = [0] * self.W
+        for name, x in vals.items():
+            w, off, wd = self.layout[name]
+            words[w] |= (x & ((1 << wd) - 1)) << off
+        return words
+
+    def _emit_from_words(self, recv: int, words: Sequence[int]) -> Tuple:
+        from hpa2_tpu.models.protocol import MsgType as MT
+
+        mtype = self._dec(words, "type")
+        second = self._dec(words, "second") - 1
+        aux = self._dec(words, "aux")
+        if mtype == int(MT.REPLY_RD):
+            value, sharers = aux & 0xFF, (2 if (aux >> 8) & 1 else 0)
+        elif mtype in (int(MT.REPLY_ID), int(MT.NACK)):
+            value, sharers = 0, aux
+        else:
+            value, sharers = aux & 0xFF, 0
+        return (recv, mtype, value, second, sharers)
+
+    # -- the probe ----------------------------------------------------
+
+    def probe(self, scn: Scenario) -> Observed:
+        import numpy as np
+
+        pe = self.pe
+        cfg, slsc = self.cfg, self.slsc
+        n = cfg.num_procs
+        r = scn.receiver
+        st = {k: v.copy()
+              for k, v in pe._init_state(cfg, 1, snapshots=False).items()}
+
+        st["cachew"][r, scn.line_index, 0] = (
+            scn.line_state
+            | (scn.line_value << pe._CW_VAL_SHIFT)
+            | ((scn.line_addr + 1) << pe._CW_ADDR_SHIFT))
+        # dir fields first, then the memory byte: correct whether or
+        # not the scenario's dir_blk and mem_blk coincide
+        dw = int(st["dirw"][r, scn.dir_blk, 0])
+        st["dirw"][r, scn.dir_blk, 0] = (
+            (dw & 0xFF)
+            | (scn.dir_state << pe._DW_STATE_SHIFT)
+            | (scn.dir_sharers << pe._DW_SH_SHIFT))
+        mw = int(st["dirw"][r, scn.mem_blk, 0])
+        st["dirw"][r, scn.mem_blk, 0] = (mw & ~0xFF) | scn.mem_value
+
+        tr = np.zeros((n, 1, 1), np.int32)
+        tr_len = np.zeros((n, 1), np.int32)
+        mb_count = 0
+        if scn.is_instr:
+            tr[r, 0, 0] = (
+                (0 if scn.instr_op == "R" else 1)
+                | (scn.instr_value << 1)
+                | (scn.instr_addr << pe._TR_ADDR_SHIFT))
+            tr_len[r, 0] = 1
+        else:
+            mb_count = 1
+            for w, word in enumerate(self._msg_words(scn)):
+                st[f"mb{w}"][r, 0, 0] = word
+        st["nsw"][r, 0] = (
+            mb_count
+            | (int(scn.waiting) << slsc["off_wait"])
+            | (scn.pending << slsc["off_pw"]))
+
+        out = self.call(st, {"tr": tr, "tr_len": tr_len})
+        out = {k: np.asarray(v) for k, v in out.items()}
+
+        addr_mask = (1 << 21) - 1
+        cw = int(out["cachew"][r, scn.line_index, 0])
+        dw = int(out["dirw"][r, scn.dir_blk, 0])
+        nsw = int(out["nsw"][r, 0])
+        emits = []
+        for j in range(n):
+            if j == r:
+                continue
+            cnt = int(out["nsw"][j, 0]) & slsc["count_mask"]
+            for k in range(cnt):
+                words = [int(out[f"mb{w}"][j, k, 0])
+                         for w in range(self.W)]
+                emits.append(self._emit_from_words(j, words))
+        return Observed(
+            line_addr=((cw >> pe._CW_ADDR_SHIFT) & addr_mask) - 1,
+            line_value=(cw >> pe._CW_VAL_SHIFT) & 0xFF,
+            line_state=cw & 3,
+            dir_state=(dw >> pe._DW_STATE_SHIFT) & 3,
+            dir_sharers=(dw >> pe._DW_SH_SHIFT) & ((1 << n) - 1),
+            mem_value=int(out["dirw"][r, scn.mem_blk, 0]) & 0xFF,
+            waiting=bool((nsw >> slsc["off_wait"]) & 1),
+            emits=emits,
+        ).normalized()
+
+
 # ---------------------------------------------------------------------------
 # diffing
 # ---------------------------------------------------------------------------
@@ -515,6 +665,8 @@ def diff_backend(
     prober = None
     if backend == "jax":
         prober = JaxProber(sem)
+    elif backend == "pallas":
+        prober = PallasProber(sem)
     for row in rows:
         scn = scenario_for(row)
         if scn is None:
@@ -522,7 +674,7 @@ def diff_backend(
         exp = expected_for(row, scn)
         if backend == "spec":
             obs = probe_spec(scn, sem)
-        elif backend == "jax":
+        elif backend in ("jax", "pallas"):
             obs = prober.probe(scn)
         elif backend == "native":
             obs = probe_native(scn, sem)

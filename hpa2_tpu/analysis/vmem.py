@@ -1,0 +1,190 @@
+"""Static VMEM budget model for the Pallas engine.
+
+The 16 MB per-core VMEM cap is the binding constraint on block width
+(PERF.md: block 1024 missed the cap by ~0.5-1.6 MB with the trace
+plane resident).  This module predicts the kernel's structural VMEM
+footprint from a :class:`SystemConfig` plus the kernel shape — block,
+trace window, mailbox capacity, sharer words, gate, snapshots,
+streaming on/off — WITHOUT compiling anything, so budget regressions
+fail in tier-1 unit tests instead of on a dead TPU tunnel weeks later.
+
+Accounting (everything is an i32 plane with the lane axis minor, so a
+"row" is one i32 per lane and ``bytes = rows * block * 4``):
+
+* carried planes (``state_shapes``): each blocked in/out pair is
+  charged ``PIPELINE_COPIES`` buffers (pallas double-buffers blocked
+  operands across grid steps; input/output aliasing makes the pair
+  share), plus the live while-loop carry — doubled under ``gate=True``
+  because the ``lax.cond`` burst keeps both branch carries live.
+* trace plane: under streaming it leaves the blocked operands
+  entirely — HBM (``memory_space=ANY``) costs no VMEM — and is charged
+  as the 2-slot DMA scratch plus the live window carry.  The legacy
+  path charges the full blocked window like any other operand.
+* snapshot planes: streamed through single-copy VMEM scratch (plus
+  live carry) instead of pipelined blocked operands.
+
+The model is structural: XLA/Mosaic temporaries for the cycle body are
+not modeled (they are lane-width-independent vector registers to first
+order).  ``scripts/probe_compile.py`` prints the model next to the
+compiler-measured figure on a real TPU so the 10%-agreement acceptance
+check is one tunnel session away.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+from hpa2_tpu.config import SystemConfig
+
+#: per-core VMEM on the target parts (v4/v5 generation: 16 MiB)
+VMEM_CAP_BYTES = 16 * 1024 * 1024
+BYTES_PER_ROW_PER_LANE = 4  # everything is i32
+
+#: blocked pallas operands are pipelined across grid steps: one buffer
+#: being computed on, one in flight (input/output aliasing folds the
+#: in/out pair into the same double-buffered allocation)
+PIPELINE_COPIES = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class VmemBudget:
+    """Predicted structural VMEM footprint of one kernel block."""
+
+    config: SystemConfig
+    block: int
+    window: int
+    snapshots: bool
+    gate: bool
+    stream: bool
+    rows: Dict[str, int]        # carried rows/lane per plane
+    carried_rows: int           # sum over carried (non-snapshot) planes
+    snap_rows: int              # sum over snapshot planes
+    trace_rows: int             # trace window rows/lane (tr + tr_len)
+    operand_rows: int           # pipelined blocked-operand rows/lane
+    live_rows: int              # live loop-carry rows/lane
+    scratch_rows: int           # DMA scratch rows/lane (streaming)
+    total_rows: int             # everything, rows per lane
+
+    @property
+    def total_bytes(self) -> int:
+        return self.total_rows * self.block * BYTES_PER_ROW_PER_LANE
+
+    @property
+    def fits(self) -> bool:
+        return self.total_bytes <= VMEM_CAP_BYTES
+
+    @property
+    def headroom_bytes(self) -> int:
+        return VMEM_CAP_BYTES - self.total_bytes
+
+
+def _plane_rows(config: SystemConfig, snapshots: bool) -> Dict[str, int]:
+    from hpa2_tpu.ops.pallas_engine import state_shapes
+
+    shapes = state_shapes(config, snapshots)
+    rows = {}
+    for name, prefix in shapes.items():
+        r = 1
+        for d in prefix:
+            r *= d
+        rows[name] = r
+    return rows
+
+
+def vmem_budget(
+    config: SystemConfig,
+    block: int,
+    window: int,
+    *,
+    snapshots: bool = False,
+    gate: bool = False,
+    stream: bool = True,
+) -> VmemBudget:
+    """Predict the per-block VMEM footprint of the run kernel."""
+    n = config.num_procs
+    rows = _plane_rows(config, snapshots)
+    snap_rows = sum(r for f, r in rows.items() if f.startswith("snap_"))
+    carried_rows = sum(
+        r for f, r in rows.items() if not f.startswith("snap_")
+    )
+    trace_rows = n * window + n  # tr + tr_len
+
+    live_copies = 2 if gate else 1
+
+    if stream:
+        # blocked operands: carried state + tr_len + the status plane
+        # (trace and snapshot planes moved to HBM: zero blocked copies)
+        operand = (carried_rows + n + 1) * PIPELINE_COPIES
+        # the window plane is closed over by the burst loops, not
+        # carried — one live copy regardless of the gate's lax.cond
+        live = (carried_rows + snap_rows) * live_copies + trace_rows
+        # 2-slot trace double buffer; snapshots staged in 1-copy scratch
+        scratch = 2 * n * window + snap_rows
+    else:
+        operand = (carried_rows + snap_rows + trace_rows) * PIPELINE_COPIES
+        live = (carried_rows + snap_rows + trace_rows) * live_copies
+        scratch = 0
+
+    total = operand + live + scratch
+    return VmemBudget(
+        config=config, block=block, window=window, snapshots=snapshots,
+        gate=gate, stream=stream, rows=rows, carried_rows=carried_rows,
+        snap_rows=snap_rows, trace_rows=trace_rows, operand_rows=operand,
+        live_rows=live, scratch_rows=scratch, total_rows=total,
+    )
+
+
+def _fmt_mb(b: int) -> str:
+    return f"{b / (1024 * 1024):6.2f}"
+
+
+def budget_table(
+    config: SystemConfig,
+    blocks: Tuple[int, ...] = (512, 1024, 2048),
+    window: int = 32,
+    *,
+    snapshots: bool = False,
+    gate: bool = False,
+) -> str:
+    """The ``analysis vmem`` report: streamed vs legacy footprint per
+    block width against the 16 MiB cap."""
+    lines = [
+        f"VMEM budget model  (n={config.num_procs} cap="
+        f"{config.msg_buffer_size} window={window} "
+        f"snapshots={snapshots} gate={gate}; cap "
+        f"{_fmt_mb(VMEM_CAP_BYTES).strip()} MiB)",
+        f"{'block':>6} {'mode':>8} {'rows/lane':>10} {'MiB':>7} "
+        f"{'headroom':>9}  fits",
+    ]
+    for block in blocks:
+        for stream in (True, False):
+            bud = vmem_budget(
+                config, block, window,
+                snapshots=snapshots, gate=gate, stream=stream,
+            )
+            lines.append(
+                f"{block:>6} {'stream' if stream else 'legacy':>8} "
+                f"{bud.total_rows:>10} {_fmt_mb(bud.total_bytes)} "
+                f"{_fmt_mb(bud.headroom_bytes)}  "
+                f"{'yes' if bud.fits else 'NO'}"
+            )
+    return "\n".join(lines)
+
+
+def measured_vmem_bytes(compiled) -> Optional[int]:
+    """Best-effort compiler-reported VMEM figure from a compiled
+    jax executable (``lowered.compile()``).  Returns None when the
+    backend does not expose a memory analysis (e.g. CPU interpret
+    builds)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    for attr in ("temp_size_in_bytes", "temp_bytes"):
+        v = getattr(ma, attr, None)
+        if v:
+            return int(v)
+    return None

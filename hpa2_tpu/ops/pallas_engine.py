@@ -285,6 +285,36 @@ def deferred_valid(config: SystemConfig, s) -> jnp.ndarray:
 TRACE_FIELDS = ("tr", "tr_len")
 
 
+def state_shapes(config: SystemConfig, snapshots: bool):
+    """Per-field carried-state shapes WITHOUT the trailing lane axis.
+    Single source of truth for the kernel builders and the static
+    VMEM budget model (hpa2_tpu/analysis/vmem.py)."""
+    n, c, m = config.num_procs, config.cache_size, config.mem_size
+    cap, nt = config.msg_buffer_size, _NTYPES
+    layout, W = _mb_layout(config)
+    split_sw = _sharer_words(config) if _split_mode(config) else 0
+    shapes = {
+        "cachew": (n, c), "dirw": (n, m),
+        "nsw": (n,),
+        "scalars": (_NSCALAR,), "msg_counts": (nt,),
+    }
+    if "recv" not in layout:
+        shapes["ob_recv"] = (n, _NSLOTS)
+    if snapshots:
+        shapes.update({
+            "snap_taken": (n,), "snap_cachew": (n, c),
+            "snap_dirw": (n, m),
+        })
+    for w in range(split_sw):
+        shapes[f"dirs{w}"] = (n, m)
+        if snapshots:
+            shapes[f"snap_dirs{w}"] = (n, m)
+    for w in range(W):
+        shapes[f"mb{w}"] = (n, cap)
+        shapes[f"ob{w}"] = (n, _NSLOTS)
+    return shapes
+
+
 def _popcount(x):
     """popcount on int32 bit patterns (SWAR; Mosaic-safe)."""
     u = x.astype(U32)
@@ -1310,26 +1340,12 @@ def _build_call(config: SystemConfig, b: int, bb: int, k: int,
     if b % bb != 0:
         raise ValueError(f"batch {b} not divisible by block {bb}")
     cycle = build_cycle(config, bb, snapshots, ablate)
-    n, c, m = config.num_procs, config.cache_size, config.mem_size
-    cap, nt = config.msg_buffer_size, _NTYPES
+    n = config.num_procs
     layout, W = _mb_layout(config)
     split_sw = _sharer_words(config) if _split_mode(config) else 0
     fields = _state_fields(W, snapshots, "recv" in layout, split_sw)
     outer, inner = -(-k // _GATE), _GATE
-
-    shapes = {
-        "cachew": (n, c), "dirw": (n, m),
-        "nsw": (n,),
-        "ob_recv": (n, _NSLOTS),
-        "snap_taken": (n,), "snap_cachew": (n, c), "snap_dirw": (n, m),
-        "scalars": (_NSCALAR,), "msg_counts": (nt,),
-    }
-    for w in range(split_sw):
-        shapes[f"dirs{w}"] = (n, m)
-        shapes[f"snap_dirs{w}"] = (n, m)
-    for w in range(W):
-        shapes[f"mb{w}"] = (n, cap)
-        shapes[f"ob{w}"] = (n, _NSLOTS)
+    shapes = state_shapes(config, snapshots=True)
 
     def kernel(*refs):
         ntr = len(TRACE_FIELDS)
@@ -1483,6 +1499,261 @@ def _build_run(config: SystemConfig, b: int, bb: int, k: int,
     return jax.jit(run_all)
 
 
+@functools.lru_cache(maxsize=16)
+def _build_stream_run(config: SystemConfig, b: int, bb: int, k: int,
+                      interpret: bool, snapshots: bool, window: int,
+                      n_seg: int, max_calls: int,
+                      ablate: frozenset = frozenset(),
+                      gate: bool = True):
+    """The HBM-streaming run program: ONE pallas_call drives the whole
+    run (fori over trace windows x while-to-quiescence), with the
+    windowed trace plane living in HBM (``memory_space=pltpu.ANY``)
+    and streamed through a 2-slot VMEM scratch by double-buffered
+    ``make_async_copy`` — window i+1 prefetches while window i runs,
+    so the copy overlaps the while-to-quiescence loop and only the
+    2*window-row scratch (not the whole trace) counts against the
+    16 MB VMEM cap.  The phase-D snapshot planes likewise stay in HBM
+    and are DMA-staged through VMEM scratch once per run (they must be
+    VMEM-resident across cycles — phase D writes them every cycle —
+    but their pipelined in/out block copies are gone).  Stall status
+    leaves through a per-lane plane so the host keeps its single
+    readback."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if b % bb != 0:
+        raise ValueError(f"batch {b} not divisible by block {bb}")
+    cycle = build_cycle(config, bb, snapshots, ablate)
+    n = config.num_procs
+    layout, W = _mb_layout(config)
+    split_sw = _sharer_words(config) if _split_mode(config) else 0
+    fields = _state_fields(W, snapshots, "recv" in layout, split_sw)
+    shapes = state_shapes(config, snapshots=True)
+    slsc = _scalar_layout(config, window)
+    outer, inner = -(-k // _GATE), _GATE
+    # snapshot planes stream; everything else stays VMEM-resident
+    snap_fields = tuple(f for f in fields if f.startswith("snap_"))
+    vmem_fields = tuple(f for f in fields if not f.startswith("snap_"))
+    nst, nsnap = len(vmem_fields), len(snap_fields)
+
+    def active_count(st, tl):
+        # integer quiescence check (bool-vector reductions are not
+        # Mosaic-lowerable): outstanding instrs + waiting + queued
+        # messages + deferred outbox slots
+        nswv = st["nsw"]
+        pcv = (nswv >> slsc["off_pc"]) & slsc["pc_mask"]
+        return (
+            jnp.sum(jnp.maximum(tl - pcv, 0))
+            + jnp.sum((nswv >> slsc["off_wait"]) & 1)
+            + jnp.sum(nswv & slsc["count_mask"])
+            + jnp.sum(deferred_valid(config, st))
+        )
+
+    def kernel(*refs):
+        tr_len_ref = refs[0]
+        tr_hbm = refs[1]
+        in_vmem = refs[2:2 + nst]
+        in_snap = refs[2 + nst:2 + nst + nsnap]
+        o = 2 + nst + nsnap
+        out_vmem = refs[o:o + nst]
+        out_snap = refs[o + nst:o + nst + nsnap]
+        status_ref = refs[o + nst + nsnap]
+        sc = o + nst + nsnap + 1
+        tr_buf, tr_sem = refs[sc], refs[sc + 1]
+        snap_bufs = refs[sc + 2:sc + 2 + nsnap]
+        snap_sem = refs[sc + 2 + nsnap] if snapshots else None
+
+        i = pl.program_id(0)
+
+        def lane_block(ref):
+            idx = (slice(None),) * (len(ref.shape) - 1)
+            return ref.at[idx + (pl.ds(i * bb, bb),)]
+
+        def tr_dma(slot, seg):
+            return pltpu.make_async_copy(
+                tr_hbm.at[
+                    :, pl.ds(seg * window, window), pl.ds(i * bb, bb)
+                ],
+                tr_buf.at[slot],
+                tr_sem.at[slot],
+            )
+
+        tr_dma(0, 0).start()
+        for j in range(nsnap):
+            pltpu.make_async_copy(
+                lane_block(in_snap[j]), snap_bufs[j], snap_sem.at[j]
+            ).start()
+
+        s = {f: in_vmem[j][:] for j, f in enumerate(vmem_fields)}
+        tl_full = tr_len_ref[:]
+
+        for j in range(nsnap):
+            pltpu.make_async_copy(
+                lane_block(in_snap[j]), snap_bufs[j], snap_sem.at[j]
+            ).wait()
+        s.update(
+            {f: snap_bufs[j][:] for j, f in enumerate(snap_fields)}
+        )
+
+        def seg_body(si, carry):
+            st, stalled, calls0 = carry
+            slot = jax.lax.rem(si, 2)
+            tr_dma(slot, si).wait()
+
+            @pl.when(si + 1 < n_seg)
+            def _():
+                tr_dma(1 - slot, si + 1).start()
+
+            # the window plane and its lengths are CLOSED OVER by the
+            # burst loops, not threaded through their carries: a loop
+            # invariant costs one live copy, where a carried operand
+            # would double again under the gate's lax.cond
+            trw = jax.lax.cond(
+                slot == 0, lambda: tr_buf[0], lambda: tr_buf[1]
+            )
+            tl_seg = jnp.clip(tl_full - si * window, 0, window)
+            # window base: every lane is quiescent here (enforced via
+            # the stalled flag), so the pc restart is a field clear
+            st = {
+                **st,
+                "nsw": st["nsw"]
+                & ~(slsc["pc_mask"] << slsc["off_pc"]),
+            }
+
+            def cyc(x):
+                out = cycle({**x, "tr": trw, "tr_len": tl_seg})
+                return {f: out[f] for f in fields}
+
+            def run_gate(st2):
+                return jax.lax.fori_loop(
+                    0, inner, lambda _, x: cyc(x), st2
+                )
+
+            def k_cycles(st2):
+                if not gate:
+                    return jax.lax.fori_loop(
+                        0, k, lambda _, x: cyc(x), st2
+                    )
+
+                def gbody(_, x):
+                    return jax.lax.cond(
+                        active_count(x, tl_seg) == 0,
+                        lambda y: y, run_gate, x,
+                    )
+
+                return jax.lax.fori_loop(0, outer, gbody, st2)
+
+            def cond(c):
+                st2, calls = c
+                return (active_count(st2, tl_seg) != 0) & (
+                    calls < max_calls
+                )
+
+            def body(c):
+                st2, calls = c
+                return k_cycles(st2), calls + 1
+
+            # the call counter carries ACROSS windows so max_calls
+            # bounds the whole run, not each window separately
+            (st, calls1) = jax.lax.while_loop(cond, body, (st, calls0))
+            stalled = stalled | jnp.where(
+                active_count(st, tl_seg) != 0, 1, 0
+            )
+            return st, stalled, calls1
+
+        s, stalled, _ = jax.lax.fori_loop(
+            0, n_seg, seg_body, (s, jnp.int32(0), jnp.int32(0))
+        )
+
+        for j, f in enumerate(vmem_fields):
+            out_vmem[j][:] = s[f]
+        for j, f in enumerate(snap_fields):
+            snap_bufs[j][:] = s[f]
+        for j in range(nsnap):
+            pltpu.make_async_copy(
+                snap_bufs[j], lane_block(out_snap[j]), snap_sem.at[j]
+            ).start()
+        for j in range(nsnap):
+            pltpu.make_async_copy(
+                snap_bufs[j], lane_block(out_snap[j]), snap_sem.at[j]
+            ).wait()
+        status_ref[:] = jnp.zeros((1, bb), I32) + stalled
+
+    def block_spec(prefix_shape):
+        shape = tuple(prefix_shape) + (bb,)
+        nd = len(shape)
+        return pl.BlockSpec(
+            shape,
+            (lambda i, _nd=nd: (0,) * (_nd - 1) + (i,)),
+            memory_space=pltpu.VMEM,
+        )
+
+    hbm_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+
+    in_specs = (
+        [block_spec((n,)), hbm_spec]
+        + [block_spec(shapes[f]) for f in vmem_fields]
+        + [hbm_spec] * nsnap
+    )
+    out_specs = (
+        [block_spec(shapes[f]) for f in vmem_fields]
+        + [hbm_spec] * nsnap
+        + [block_spec((1,))]
+    )
+    out_shape = (
+        [
+            jax.ShapeDtypeStruct(tuple(shapes[f]) + (b,), jnp.int32)
+            for f in vmem_fields
+        ]
+        + [
+            jax.ShapeDtypeStruct(tuple(shapes[f]) + (b,), jnp.int32)
+            for f in snap_fields
+        ]
+        + [jax.ShapeDtypeStruct((1, b), jnp.int32)]
+    )
+    aliases = {2 + j: j for j in range(nst + nsnap)}
+    scratch_shapes = [
+        pltpu.VMEM((2, n, window, bb), jnp.int32),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
+    if snapshots:
+        scratch_shapes += [
+            pltpu.VMEM(tuple(shapes[f]) + (bb,), jnp.int32)
+            for f in snap_fields
+        ]
+        scratch_shapes += [pltpu.SemaphoreType.DMA((nsnap,))]
+
+    fn = pl.pallas_call(
+        kernel,
+        grid=(b // bb,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        scratch_shapes=scratch_shapes,
+        interpret=interpret,
+    )
+
+    def run_all(state, tr_full, tr_len_full):
+        outs = fn(
+            tr_len_full,
+            tr_full,
+            *[state[f] for f in vmem_fields],
+            *[state[f] for f in snap_fields],
+        )
+        new_state = dict(zip(vmem_fields, outs[:nst]))
+        new_state.update(zip(snap_fields, outs[nst:nst + nsnap]))
+        stalled = jnp.any(outs[-1] != 0)
+        overflow = jnp.any(new_state["scalars"][_SC_OVERFLOW] > 0)
+        status = (
+            stalled.astype(jnp.int32)
+            | (overflow.astype(jnp.int32) << 1)
+        )
+        return new_state, status
+
+    return jax.jit(run_all)
+
+
 class PallasEngine:
     """Ensemble engine with VMEM-resident cycles (the fast path).
 
@@ -1499,6 +1770,14 @@ class PallasEngine:
     trace plane (the dominant VMEM tenant) bounded for arbitrarily
     long workloads (the reference caps traces at 32 instructions,
     assignment.c:13; this is the uncapped analog).
+
+    ``stream=True`` (the default) moves the whole run loop inside one
+    pallas_call and streams the trace plane from HBM through a 2-slot
+    double-buffered VMEM scratch (snapshot planes likewise DMA-staged)
+    — the trace no longer counts against the per-block VMEM budget,
+    which is what lets block 1024/2048 fit under the 16 MB cap.
+    ``stream=False`` keeps the legacy host-composed window loop with
+    the fully VMEM-resident per-call kernel.
     """
 
     def __init__(
@@ -1514,6 +1793,7 @@ class PallasEngine:
         snapshots: bool = True,
         trace_window: Optional[int] = None,
         gate: bool = True,
+        stream: bool = True,
         _ablate: frozenset = frozenset(),
     ):
         if interpret is None:
@@ -1564,11 +1844,29 @@ class PallasEngine:
         self._ablate = _ablate
         self._interpret = interpret
         self._gate = gate
+        self._stream = stream
         self._completed = False
         self._poisoned = False
         self._call = _build_call(
             config, b, self.block, cycles_per_call, interpret,
             snapshots, _ablate, gate
+        )
+
+    def _runner(self, max_cycles: int):
+        max_calls = max(1, -(-max_cycles // self.cycles_per_call))
+        build = _build_stream_run if self._stream else _build_run
+        return build(
+            self.config, self.b, self.block, self.cycles_per_call,
+            self._interpret, self._snapshots, self._window, self._n_seg,
+            max_calls, self._ablate, self._gate,
+        )
+
+    def lower_run(self, max_cycles: int = 1_000_000):
+        """Lower (without executing) the whole-run program — the
+        compile-gate entry point: ``lower_run().compile()`` on a TPU
+        reports the kernel's real VMEM footprint."""
+        return self._runner(max_cycles).lower(
+            self.state, self._tr_full, self._tr_len_full
         )
 
     def run(self, max_cycles: int = 1_000_000) -> "PallasEngine":
@@ -1582,12 +1880,7 @@ class PallasEngine:
                 "engine state is mid-flight after a failed run; "
                 "rebuild the engine to retry"
             )
-        max_calls = max(1, -(-max_cycles // self.cycles_per_call))
-        runner = _build_run(
-            self.config, self.b, self.block, self.cycles_per_call,
-            self._interpret, self._snapshots, self._window, self._n_seg,
-            max_calls, self._ablate, self._gate,
-        )
+        runner = self._runner(max_cycles)
         state, status = runner(
             self.state, self._tr_full, self._tr_len_full
         )

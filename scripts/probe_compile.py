@@ -1,37 +1,98 @@
 """Compile-only probe of the Pallas kernel on the TPU (no execution of
 the full bench).  Exit 0 + one JSON line on success; nonzero + the
-Mosaic error tail on failure.  Run under the TPU env."""
+Mosaic error tail on failure.  Run under the TPU env.
 
+By default this lowers the HBM-STREAMING whole-run program (the thing
+the bench actually executes) and reports the compiler-measured VMEM
+figure next to the static budget model's prediction
+(hpa2_tpu/analysis/vmem.py), so one live tunnel session settles the
+model-vs-compiler agreement check.  ``--legacy`` probes the old
+per-call VMEM-resident kernel instead; ``--block/--window/--gate``
+sweep the shape (block 1024/2048 are the levers the model predicts
+now fit under the 16 MiB cap).
+"""
+
+import argparse
 import json
+import re
 import sys
 import time
 
 sys.path.insert(0, "/root/repo")
 
 
+def _measured_vmem_from_error(msg: str):
+    """Mosaic over-budget errors name the request in bytes; scrape it
+    so a failed compile still yields a measured figure."""
+    m = re.search(r"(\d+)\s*bytes.{0,80}(vmem|VMEM)", msg) or re.search(
+        r"(vmem|VMEM).{0,120}?(\d{6,})", msg)
+    if not m:
+        return None
+    digits = [g for g in m.groups() if g and g.isdigit()]
+    return int(digits[0]) if digits else None
+
+
 def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--block", type=int, default=1024)
+    p.add_argument("--window", type=int, default=16)
+    p.add_argument("--gate", action="store_true")
+    p.add_argument("--legacy", action="store_true",
+                   help="probe the non-streaming per-call kernel")
+    args = p.parse_args()
+
     import jax
     import numpy as np
 
+    from hpa2_tpu.analysis.vmem import measured_vmem_bytes, vmem_budget
     from hpa2_tpu.config import Semantics, SystemConfig
     from hpa2_tpu.ops.pallas_engine import PallasEngine
 
     config = SystemConfig(
         num_procs=8, msg_buffer_size=16, semantics=Semantics().robust()
     )
-    b, t = 1024, 16
+    b, t = args.block, 2 * args.window
     tr_op = np.zeros((b, 8, t), np.int32)
     tr_addr = np.zeros((b, 8, t), np.int32)
     tr_val = np.zeros((b, 8, t), np.int32)
     tr_len = np.full((b, 8), t, np.int32)
     eng = PallasEngine(config, tr_op, tr_addr, tr_val, tr_len,
-                       cycles_per_call=8, interpret=False,
-                       snapshots=False)
+                       block=args.block, cycles_per_call=8,
+                       interpret=False, snapshots=False,
+                       trace_window=args.window, gate=args.gate,
+                       stream=not args.legacy)
+    bud = vmem_budget(config, args.block, args.window,
+                      snapshots=False, gate=args.gate,
+                      stream=not args.legacy)
+    out = {
+        "block": args.block, "window": args.window,
+        "gate": args.gate, "stream": not args.legacy,
+        "model_vmem_bytes": bud.total_bytes,
+        "model_fits": bud.fits,
+    }
     t0 = time.time()
-    eng._call.lower(eng.state, eng.traces).compile()
-    dt = time.time() - t0
-    print(json.dumps({"ok": True, "compile_s": round(dt, 1),
-                      "platform": jax.devices()[0].platform}))
+    try:
+        compiled = eng.lower_run(max_cycles=10_000).compile()
+    except Exception as e:  # noqa: BLE001 - report ANY compile failure
+        msg = str(e)
+        out.update({
+            "ok": False,
+            "measured_vmem_bytes": _measured_vmem_from_error(msg),
+            "error_tail": msg[-800:],
+        })
+        print(json.dumps(out))
+        return 1
+    measured = measured_vmem_bytes(compiled)
+    out.update({
+        "ok": True,
+        "compile_s": round(time.time() - t0, 1),
+        "measured_vmem_bytes": measured,
+        "platform": jax.devices()[0].platform,
+    })
+    if measured:
+        out["model_vs_measured"] = round(
+            bud.total_bytes / measured, 3)
+    print(json.dumps(out))
     return 0
 
 

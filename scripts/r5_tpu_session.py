@@ -8,13 +8,23 @@ completes — a dropped tunnel mid-session loses only the running step.
 
 Steps, in value order:
   1. probe         — is a TPU visible at all?
-  2. bench         — python bench.py (captures BENCH_LAST_TPU.json)
-  3. differential  — scripts/tpu_differential.py (Mosaic-vs-XLA gate)
-  4. sweep512      — current bench shape, full-run wall clock
-  5. block1024     — PERF.md lever 1: window 8, gate off, block 1024
-                     (compile fit was the round-4 blocker)
-  6. sweeps        — a few block/window/gate points around the winner
-  7. scale4/scale5 — BASELINE.json configs 4-5 (scripts/scale_runs.py)
+  2. vmemprobe     — compile-only streaming-kernel probes at block
+                     512/1024/2048 (scripts/probe_compile.py):
+                     compiler-measured VMEM vs the static budget
+                     model (hpa2_tpu/analysis/vmem.py) — the 10%
+                     model-agreement acceptance check
+  3. bench         — python bench.py (captures BENCH_LAST_TPU.json)
+  4. differential  — scripts/tpu_differential.py (Mosaic-vs-XLA gate)
+  5. sweep512      — current bench shape, full-run wall clock
+  6. block1024     — PERF.md lever 1: window 8, gate off, block 1024
+                     (HBM-streamed kernel; compile fit was the
+                     round-4 blocker)
+  7. block2048     — the next doubling, streaming kernel, window 8
+  8. sweeps        — a few block/window/gate points around the winner
+  9. scale4/scale5 — BASELINE.json configs 4-5 (scripts/scale_runs.py)
+
+All measure() steps run the HBM-streaming run program (PallasEngine
+default stream=True since the VMEM-wall PR).
 
 Usage: python scripts/r5_tpu_session.py [--skip probe,bench,...]
 """
@@ -239,6 +249,18 @@ def main() -> int:
         state["fails"] = 0 if rec.get("ok") else state["fails"] + 1
         return rec
 
+    if "vmemprobe" not in skip:
+        # compile-only: cheap, and settles model-vs-compiler VMEM
+        # agreement before any expensive timing step
+        probe = os.path.join(REPO, "scripts", "probe_compile.py")
+        for blk, win in ((512, 32), (1024, 8), (2048, 8)):
+            nm = f"vmemprobe{blk}"
+            if gate(nm):
+                note(run_py(
+                    nm,
+                    [probe, "--block", str(blk), "--window", str(win)],
+                    timeout_s=600, argv=True))
+
     if "bench" not in skip and gate("bench"):
         note(run_py("bench", [os.path.join(REPO, "bench.py")],
                     timeout_s=1800, argv=True))
@@ -258,6 +280,12 @@ def main() -> int:
         # gate off (no lax.cond carry doubling), k sized to the
         # per-window cycle need
         note(measure("block1024", 32768, 128, 1024, 64, 16, 8, 0))
+
+    if "block2048" not in skip and gate("block2048"):
+        # the next lane doubling, reachable only because the trace
+        # plane streams from HBM (the budget model predicts ~1.3 MiB
+        # of headroom at window 8, gate off)
+        note(measure("block2048", 32768, 128, 2048, 64, 16, 8, 0))
 
     if "sweeps" not in skip:
         for nm, params in (

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Slow tier — everything the tier-1 gate excludes with -m 'not slow':
+#
+#   * the oversubscribed TSan workloads (2x-cores OMP threads over a
+#     32-node system; tests/test_sanitizers.py)
+#   * the large randomized differential sweeps (SLOW_GEOMETRIES in
+#     tests/test_random_differential.py: deeper traces, wider node
+#     counts, both split-plane widths)
+#   * the 64-node SW=3 split-plane differential (~5 min interpret
+#     mode; tests/test_pallas_engine.py)
+#
+# Run on demand (pre-release, after touching the native OMP engine or
+# the pallas sv_* helpers) — not part of the per-session gate.  Budget
+# ~20-30 min.  Extra args pass through to pytest (e.g. -k tsan).
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+# build the TSan binary up front so a missing toolchain is reported
+# once here, instead of as per-test skips that are easy to miss
+if ! make -C native tsan >/dev/null 2>&1; then
+    echo "WARNING: TSan build unavailable; sanitizer tests will skip" >&2
+fi
+
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -v -m slow \
+    --continue-on-collection-errors -p no:cacheprovider "$@"

@@ -6,8 +6,6 @@ Runs in Pallas interpreter mode (CPU); the kernel path is exercised on
 real TPU by bench.py.
 """
 
-import os
-
 import numpy as np
 import pytest
 
@@ -137,6 +135,121 @@ def test_pallas_trace_window_matches_spec_segmented():
     assert total_instr == pe.instructions
 
 
+# -- windowed-trace edge cases on the HBM-streaming run program -------
+
+
+def _spec_on_window_schedule(cfg, op, addr, val, b, n, w, t):
+    """Spec engine run on the same legal window schedule the engine
+    executes (w instructions per core per segment, quiesce between)."""
+    from hpa2_tpu.models.spec_engine import SpecEngine
+
+    traces = _traces_from_arrays(op, addr, val, b, n)
+    spec = SpecEngine(cfg, [tr[:w] for tr in traces])
+    spec.run()
+    for s in range(w, t, w):
+        spec.continue_with([tr[s:s + w] for tr in traces])
+        spec.run()
+    return spec
+
+
+@pytest.mark.parametrize(
+    "w,t,gate",
+    [
+        (7, 20, False),   # window does not divide the trace length
+        (1, 6, True),     # degenerate one-instruction windows
+        (20, 20, False),  # single window spanning the whole trace
+        (8, 40, True),    # many exact windows, in-kernel gate on
+    ],
+)
+def test_stream_windowed_edges_bitexact(w, t, gate):
+    """The streaming program (double-buffered HBM prefetch, segment
+    loop in-kernel) vs the legacy host-composed window loop: every
+    carried plane must match bit-for-bit on the ragged window shapes,
+    and both must match the spec engine on the same window schedule.
+    A prefetch off-by-one (wrong segment consumed, tail window length
+    mis-clipped) shows up here as a plane diff naming the field."""
+    cfg = SystemConfig(
+        num_procs=8, msg_buffer_size=16, semantics=Semantics().robust()
+    )
+    batch = 4
+    op, addr, val, length = gen_uniform_random_arrays(
+        cfg, batch, t, seed=20 + w)
+
+    def build(stream):
+        return PallasEngine(cfg, op, addr, val, length, block=2,
+                            cycles_per_call=32, interpret=True,
+                            snapshots=False, trace_window=w,
+                            gate=gate, stream=stream)
+
+    se = build(True).run(max_cycles=400_000)
+    le = build(False).run(max_cycles=400_000)
+    for f in se.state:
+        assert (
+            np.asarray(se.state[f]) == np.asarray(le.state[f])
+        ).all(), f"stream/legacy diverged on plane {f!r}"
+    for b in range(batch):
+        spec = _spec_on_window_schedule(cfg, op, addr, val, b, 8, w, t)
+        assert _dicts(spec.final_dumps()) == _dicts(
+            se.system_final_dumps(b)
+        ), f"b={b}"
+
+
+def test_stream_windowed_split_plane_22_nodes():
+    """22 nodes (> 21) engages the split sharer planes on the
+    streaming path, with a window (5 over t=12) that leaves a ragged
+    tail segment — the split dirs{w} planes and the trace scratch ride
+    separate DMA channels, so this pins their interaction."""
+    cfg = SystemConfig(num_procs=22, cache_size=2, mem_size=4,
+                       msg_buffer_size=16,
+                       semantics=Semantics().robust())
+    op, addr, val, length = gen_uniform_random_arrays(cfg, 2, 12, seed=4)
+
+    def build(stream):
+        return PallasEngine(cfg, op, addr, val, length, block=2,
+                            cycles_per_call=32, interpret=True,
+                            snapshots=False, trace_window=5,
+                            gate=False, stream=stream)
+
+    se = build(True).run(max_cycles=400_000)
+    le = build(False).run(max_cycles=400_000)
+    for f in se.state:
+        assert (
+            np.asarray(se.state[f]) == np.asarray(le.state[f])
+        ).all(), f"stream/legacy diverged on plane {f!r}"
+    for b in range(2):
+        spec = _spec_on_window_schedule(cfg, op, addr, val, b, 22, 5, 12)
+        assert _dicts(spec.final_dumps()) == _dicts(
+            se.system_final_dumps(b)
+        ), f"b={b}"
+
+
+def test_windowed_snapshots_rejected():
+    """Dump-at-local-completion is defined on the whole trace; a
+    multi-segment window schedule must be refused up front, not
+    produce wrong snapshots later."""
+    cfg = SystemConfig(num_procs=4, semantics=Semantics().robust())
+    op, addr, val, length = gen_uniform_random_arrays(cfg, 1, 8, seed=0)
+    with pytest.raises(ValueError, match="snapshots=False"):
+        PallasEngine(cfg, op, addr, val, length, block=1,
+                     interpret=True, snapshots=True, trace_window=4)
+
+
+def test_single_window_snapshots_allowed():
+    """trace_window == t is one segment, so snapshots stay legal —
+    and on the streaming path the snapshot planes round-trip through
+    the DMA-staged scratch; they must still match the XLA engine."""
+    cfg = SystemConfig(num_procs=4, msg_buffer_size=32,
+                       semantics=Semantics().robust())
+    op, addr, val, length = gen_uniform_random_arrays(cfg, 2, 10, seed=6)
+    pe = PallasEngine(cfg, op, addr, val, length, block=2,
+                      cycles_per_call=32, interpret=True,
+                      snapshots=True, trace_window=10, stream=True).run()
+    for b in range(2):
+        jx = JaxEngine(cfg, _traces_from_arrays(op, addr, val, b, 4)).run()
+        assert _dicts(jx.snapshots()) == _dicts(pe.system_snapshots(b))
+        assert _dicts(jx.final_dumps()) == _dicts(pe.system_final_dumps(b))
+
+
 def test_pallas_run_idempotent_and_not_resumable():
     cfg = SystemConfig(
         num_procs=4, msg_buffer_size=16, semantics=Semantics().robust()
@@ -149,10 +262,7 @@ def test_pallas_run_idempotent_and_not_resumable():
     assert pe.instructions == before
 
 
-@pytest.mark.skipif(
-    not os.environ.get("HPA2_SLOW"),
-    reason="~5 min in interpret mode; set HPA2_SLOW=1 to run",
-)
+@pytest.mark.slow  # ~5 min in interpret mode (scripts/run_slow.sh)
 def test_split_plane_64_nodes_sw3():
     """Three sharer words (SW=3) on the split-plane path: 64 nodes, a
     geometry the native backend also caps at (single-word 64-bit mask)

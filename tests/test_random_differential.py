@@ -233,3 +233,35 @@ def test_adversarial_liveness_geometry(gi, tmp_path):
     arrays = gen_eviction_pingpong_arrays(cfg, batch, t, seed=7000 + gi)
     _sweep(cfg, batch, extra, arrays, tmp_path,
            allow_stall=cfg.msg_buffer_size <= 6)
+
+
+# Slow tier (scripts/run_slow.sh): the same differential body at the
+# scale the tier-1 sweeps can't afford — longer traces (deeper
+# protocol histories: more evictions per line, more NACK re-serves per
+# address), larger batches, and a wider node-count spread including
+# both split-plane widths.  Since the streaming HBM path became the
+# PallasEngine default these also soak the windowless streaming
+# program at batch sizes where a window boundary bug would compound.
+SLOW_GEOMETRIES = [
+    (SystemConfig(num_procs=8, cache_size=4, mem_size=16,
+                  msg_buffer_size=16, semantics=ROBUST),
+     32, 48, ("native", "pallas")),  # bench geometry, 3x trace depth
+    (SystemConfig(num_procs=16, cache_size=4, mem_size=16,
+                  msg_buffer_size=32, semantics=ROBUST),
+     24, 24, ("native", "pallas")),  # widest packed-word node count
+    (SystemConfig(num_procs=33, cache_size=4, mem_size=8,
+                  msg_buffer_size=32, semantics=ROBUST),
+     10, 16, ("native", "pallas")),  # split-plane SW=2, deeper
+    (SystemConfig(num_procs=48, cache_size=2, mem_size=8,
+                  msg_buffer_size=16, semantics=ROBUST),
+     6, 10, ("native", "pallas")),   # SW=2 high word occupancy
+]
+
+
+@pytest.mark.slow
+@pytest.mark.sweep
+@pytest.mark.parametrize("gi", range(len(SLOW_GEOMETRIES)))
+def test_slow_random_differential_geometry(gi, tmp_path):
+    cfg, batch, t, extra = SLOW_GEOMETRIES[gi]
+    arrays = gen_uniform_random_arrays(cfg, batch, t, seed=5000 + gi)
+    _sweep(cfg, batch, extra, arrays, tmp_path, allow_stall=False)

@@ -98,3 +98,22 @@ def test_tsan_omp_bench():
         ["--bench", "200", "--mode", "omp", "--robust", "--json"],
         {"TSAN_OPTIONS": "halt_on_error=1"},
     )
+
+
+@pytest.mark.slow
+def test_tsan_omp_oversubscribed():
+    """TSan with 2x-cores OMP threads driving a 32-node system: the
+    scheduler preempts threads mid-protocol-step, widening the
+    interleaving space far beyond the free-running default (where one
+    thread per node mostly runs unpreempted).  Races that need an
+    unlucky preemption point — e.g. between a mailbox ring index read
+    and its guarded write — surface here or nowhere."""
+    binary = _build("tsan", "hpa2sim_tsan")
+    threads = 2 * (os.cpu_count() or 4)
+    _run(
+        binary,
+        ["--bench", "1500", "--mode", "omp", "--nodes", "32",
+         "--threads", str(threads), "--robust", "--json",
+         "--seed", "11"],
+        {"TSAN_OPTIONS": "halt_on_error=1"},
+    )

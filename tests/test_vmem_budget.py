@@ -1,0 +1,161 @@
+"""Tier-1 guards derived from the static VMEM budget model
+(hpa2_tpu/analysis/vmem.py): block-width budgets that used to fail
+only at Mosaic compile time on a live TPU tunnel, model/engine
+consistency, and the streaming kernel's structural invariants — the
+per-cycle hot loop gains no ops and no DMA from streaming (copies live
+at window boundaries only)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hpa2_tpu.analysis.vmem import (
+    VMEM_CAP_BYTES, budget_table, vmem_budget)
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.ops.pallas_engine import (
+    PallasEngine, _init_state, build_cycle)
+from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+
+def _bench_config():
+    # bench.py's workload shape (8-node systems, robust semantics)
+    return SystemConfig(num_procs=8, msg_buffer_size=16,
+                        semantics=Semantics().robust())
+
+
+# ceilings for the recursively counted per-cycle jaxpr eqns at the
+# bench shape (bb=8); streaming must not grow the hot loop — a rising
+# count here is a perf regression even when the tests stay green
+_CYCLE_OPS_BASELINE = {False: 2172, True: 2194}
+
+
+class TestBudgets:
+    @pytest.mark.parametrize("block", [512, 1024, 2048])
+    def test_streaming_bench_shape_fits(self, block):
+        # the PERF.md lever shape for wide blocks: window 32, gate off
+        bud = vmem_budget(_bench_config(), block, 32,
+                          snapshots=False, gate=False, stream=True)
+        assert bud.fits, (
+            f"streaming block {block} predicted over the VMEM cap by "
+            f"{-bud.headroom_bytes} bytes"
+        )
+
+    @pytest.mark.parametrize("block", [512, 1024])
+    def test_streaming_gated_fits(self, block):
+        bud = vmem_budget(_bench_config(), block, 32,
+                          snapshots=False, gate=True, stream=True)
+        assert bud.fits
+
+    def test_streaming_beats_legacy_under_gate(self):
+        cfg = _bench_config()
+        s = vmem_budget(cfg, 1024, 32, gate=True, stream=True)
+        l = vmem_budget(cfg, 1024, 32, gate=True, stream=False)
+        assert s.total_rows < l.total_rows
+
+    def test_window_scales_scratch_not_operands(self):
+        cfg = _bench_config()
+        small = vmem_budget(cfg, 512, 8, stream=True)
+        large = vmem_budget(cfg, 512, 64, stream=True)
+        assert small.operand_rows == large.operand_rows
+        assert large.scratch_rows > small.scratch_rows
+
+    def test_cap_constant(self):
+        assert VMEM_CAP_BYTES == 16 * 1024 * 1024
+
+    def test_budget_table_renders(self):
+        out = budget_table(_bench_config())
+        assert "block" in out and "stream" in out and "legacy" in out
+
+
+class TestModelEngineConsistency:
+    @pytest.mark.parametrize("snapshots", [False, True])
+    @pytest.mark.parametrize("n", [8, 33])
+    def test_rows_match_init_state(self, snapshots, n):
+        # every plane the engine actually allocates is in the model
+        # with the exact rows/lane, and vice versa
+        cfg = SystemConfig(num_procs=n, cache_size=2, mem_size=4,
+                           semantics=Semantics().robust())
+        bud = vmem_budget(cfg, 8, 4, snapshots=snapshots)
+        state = _init_state(cfg, 8, snapshots=snapshots)
+        want = {k: v.size // 8 for k, v in state.items()}
+        assert bud.rows == want
+        assert bud.carried_rows + bud.snap_rows == sum(want.values())
+
+
+class TestHotLoopGuards:
+    def _cycle_ops(self, snapshots):
+        cfg = _bench_config()
+        bb = 8
+        st = {k: jnp.asarray(v)
+              for k, v in _init_state(cfg, bb, snapshots).items()}
+        st["tr"] = jnp.zeros((8, 8, bb), jnp.int32)
+        st["tr_len"] = jnp.zeros((8, bb), jnp.int32)
+        jx = jax.make_jaxpr(build_cycle(cfg, bb, snapshots))(st)
+        return _count_eqns(jx.jaxpr)
+
+    @pytest.mark.parametrize("snapshots", [False, True])
+    def test_cycle_opcount_no_increase(self, snapshots):
+        ops = self._cycle_ops(snapshots)
+        assert ops <= _CYCLE_OPS_BASELINE[snapshots], (
+            f"per-cycle op count grew: {ops} > "
+            f"{_CYCLE_OPS_BASELINE[snapshots]} — the hot loop must not "
+            "pay for streaming (or anything else) per cycle"
+        )
+
+    def test_streaming_dma_outside_quiescence_loop(self):
+        # copies live at window boundaries only: the while-to-
+        # quiescence loop's jaxpr must contain no DMA primitives,
+        # while the kernel overall must stream (>=1 dma_start)
+        cfg = _bench_config()
+        arrays = gen_uniform_random_arrays(cfg, 8, 16, seed=1)
+        eng = PallasEngine(cfg, *arrays, interpret=True, stream=True,
+                           snapshots=False, trace_window=8,
+                           gate=False, block=8)
+        jx = jax.make_jaxpr(eng._runner(10_000))(
+            eng.state, eng._tr_full, eng._tr_len_full)
+        kernels = _find_subjaxprs(jx.jaxpr, "pallas_call")
+        assert kernels, "streaming runner lost its pallas_call"
+        total_dma = sum(
+            _count_prims(k, ("dma_start",)) for k in kernels)
+        assert total_dma >= 2, "expected warm-up + prefetch dma_start"
+        for kernel in kernels:
+            for wh in _find_subjaxprs(kernel, "while"):
+                assert _count_prims(wh, ("dma_start", "dma_wait")) == 0
+
+
+def _subvalues(eqn):
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (list, tuple)) else (v,)
+        for x in vs:
+            if hasattr(x, "jaxpr"):
+                yield x.jaxpr
+            elif hasattr(x, "eqns"):
+                yield x
+
+
+def _count_eqns(jaxpr):
+    n = len(jaxpr.eqns)
+    for eqn in jaxpr.eqns:
+        for sub in _subvalues(eqn):
+            n += _count_eqns(sub)
+    return n
+
+
+def _find_subjaxprs(jaxpr, prim_name):
+    found = []
+    for eqn in jaxpr.eqns:
+        subs = list(_subvalues(eqn))
+        if eqn.primitive.name == prim_name:
+            found += subs
+        else:
+            for sub in subs:
+                found += _find_subjaxprs(sub, prim_name)
+    return found
+
+
+def _count_prims(jaxpr, names):
+    n = sum(1 for eqn in jaxpr.eqns if eqn.primitive.name in names)
+    for eqn in jaxpr.eqns:
+        for sub in _subvalues(eqn):
+            n += _count_prims(sub, names)
+    return n
