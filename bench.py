@@ -80,6 +80,16 @@ def _bench_config():
     )
 
 
+def _data_shards() -> int:
+    """Ensemble data-parallelism degree (``--data-shards``), carried
+    to the measurement children through the environment (the child
+    argv protocol is positional)."""
+    try:
+        return max(1, int(os.environ.get("HPA2_BENCH_DATA_SHARDS", "1")))
+    except ValueError:
+        return 1
+
+
 # ---------------------------------------------------------------------------
 # children (each runs in its own interpreter under a known-good env)
 # ---------------------------------------------------------------------------
@@ -119,7 +129,7 @@ def compile_gate_main() -> int:
     return 0
 
 
-def bench_pallas(config, batch, instrs_per_core, seed=0):
+def bench_pallas(config, batch, instrs_per_core, seed=0, data_shards=1):
     from hpa2_tpu.ops.pallas_engine import PallasEngine
     from hpa2_tpu.utils.trace import gen_uniform_random_arrays
 
@@ -127,10 +137,20 @@ def bench_pallas(config, batch, instrs_per_core, seed=0):
                                        seed=seed)
     block, window, k, gate = _tuned_shape()
 
-    def build():
-        return PallasEngine(config, *arrays, block=block,
-                            cycles_per_call=k, snapshots=False,
-                            trace_window=window, gate=gate)
+    if data_shards > 1:
+        from hpa2_tpu.parallel.sharding import DataShardedPallasEngine
+
+        def build():
+            return DataShardedPallasEngine(
+                config, *arrays, data_shards=data_shards, block=block,
+                cycles_per_call=k, snapshots=False,
+                trace_window=window, gate=gate)
+    else:
+
+        def build():
+            return PallasEngine(config, *arrays, block=block,
+                                cycles_per_call=k, snapshots=False,
+                                trace_window=window, gate=gate)
 
     build().run()  # compile + warmup
     eng = build()
@@ -184,10 +204,13 @@ def bench_omp(config, instrs_per_core, seed=0, mode="omp"):
 def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
     config = _bench_config()
     on_tpu = platform == "tpu"
+    shards = _data_shards()
     if on_tpu:
         batch, instrs_per_core = _TPU_BATCH, _TPU_INSTRS  # 33.5M instrs
     else:  # CPU smoke (pallas runs interpreted): keep it tiny
         batch, instrs_per_core = 8, 16
+    if batch % shards:  # the ensemble splits into equal lane groups
+        batch = -(-batch // shards) * shards
 
     engine = "pallas"
     err = pallas_error
@@ -195,7 +218,8 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
     if pallas_ok or not on_tpu:  # CPU always tries interpret mode
         try:
             jax_instrs, jax_dt = bench_pallas(config, batch,
-                                              instrs_per_core)
+                                              instrs_per_core,
+                                              data_shards=shards)
             ran_ok = True
         except Exception as e:  # noqa: BLE001
             err = str(e)[-300:]
@@ -222,6 +246,13 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
         "jax_instrs": jax_instrs,
         "jax_seconds": round(jax_dt, 4),
     }
+    if shards != 1:
+        import jax
+
+        result["data_shards"] = shards
+        result["n_devices"] = len(jax.devices())
+        if engine != "pallas":
+            result["data_shards_note"] = "xla fallback ran unsharded"
     if engine != "pallas":
         result["pallas_error"] = err
     else:
@@ -378,10 +409,14 @@ def _run_child(platform: str, timeout_s: int, pallas_ok: bool,
     """Run the measurement child; returns the parsed JSON dict or None."""
     try:
         hostenv = _hostenv()
+        shards = _data_shards()
         env = (
             hostenv.cache_env(dict(os.environ))
             if platform == "tpu"
-            else hostenv.forced_cpu_env()
+            # a sharded CPU smoke needs that many virtual devices
+            else hostenv.forced_cpu_env(
+                n_devices=shards if shards > 1 else None
+            )
         )
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", platform,
@@ -417,6 +452,17 @@ def main() -> int:
             len(sys.argv) < 4 or sys.argv[3] == "1",
             sys.argv[4] if len(sys.argv) > 4 else "",
         )
+    if "--data-shards" in sys.argv:
+        # split the ensemble over N local devices (DataShardedPallasEngine);
+        # carried to the children via the environment
+        i = sys.argv.index("--data-shards")
+        try:
+            os.environ["HPA2_BENCH_DATA_SHARDS"] = str(
+                int(sys.argv[i + 1])
+            )
+        except (IndexError, ValueError):
+            print("usage: bench.py [--data-shards N]", file=sys.stderr)
+            return 2
 
     tpu_ok = _probe_tpu()
     result = None

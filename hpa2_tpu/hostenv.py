@@ -52,6 +52,24 @@ def has_device_count_flag(env: Optional[dict] = None) -> bool:
     return _DEVICE_COUNT_FLAG in source.get("XLA_FLAGS", "")
 
 
+def reexec_with_virtual_mesh(
+    n_devices: int = 8, guard_var: str = "_HPA2_VMESH_REEXEC"
+) -> None:
+    """Re-exec the current script under a forced-CPU env exposing
+    ``n_devices`` virtual devices — for entry points that need a
+    multi-device mesh without TPU hardware (scripts/scale_runs.py
+    multichip mode).  No-op when the device-count flag is already set
+    or after the re-exec (``guard_var``); call BEFORE importing jax,
+    since the flag cannot take effect once the backend initialized."""
+    import sys
+
+    if os.environ.get(guard_var) == "1" or has_device_count_flag():
+        return
+    env = forced_cpu_env(n_devices=n_devices)
+    env[guard_var] = "1"
+    os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
+
+
 def shard_map(f, mesh, in_specs, out_specs, check_replication=False):
     """Version-compatible ``shard_map`` (jax is imported lazily so this
     module stays safe to import before backend init).

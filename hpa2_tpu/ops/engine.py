@@ -121,59 +121,7 @@ class JaxEngine:
             )
 
     def _stall_diagnostic(self, reason: str) -> StallDiagnostic:
-        """Structured post-mortem from the device state (mirrors
-        SpecEngine.stall_diagnostic; the JAX engine has no host-side
-        flight recorder, so "recent" messages are the still-queued
-        mailbox heads — exactly the traffic the stall left in
-        flight)."""
-        from hpa2_tpu.utils.invariants import check_invariants
-
-        st = self.state
-        cfg = self.config
-        n = cfg.num_procs
-        mb_count = np.asarray(st.mb_count)
-        waiting = np.asarray(st.waiting)
-        blocked = np.any(np.asarray(st.ob_valid), axis=1)
-        caddr = np.asarray(st.cache_addr)
-        cval = np.asarray(st.cache_val)
-        cstate = np.asarray(st.cache_state)
-        line_states = {}
-        for i in range(n):
-            lines = []
-            for idx in range(cfg.cache_size):
-                a = int(caddr[i, idx])
-                if a == -1:
-                    continue
-                lines.append(
-                    f"[{idx}] 0x{a:02X}="
-                    f"{CacheState(int(cstate[i, idx])).name}"
-                    f"({int(cval[i, idx])})"
-                )
-            line_states[i] = lines
-        mb_data = np.asarray(st.mb_data)
-        queued = []
-        for i in range(n):
-            for s_i in range(min(int(mb_count[i]), 4)):
-                row = mb_data[i, s_i]
-                queued.append(
-                    f"queued at node {i}[{s_i}]: from "
-                    f"{int(row[MB_SENDER])} "
-                    f"{MsgType(int(row[MB_TYPE])).name} "
-                    f"0x{int(row[MB_ADDR]):02X}"
-                )
-        return StallDiagnostic(
-            reason=reason,
-            cycle=int(st.cycle),
-            mailbox_depths={i: int(mb_count[i]) for i in range(n)},
-            waiting=[i for i in range(n) if waiting[i]],
-            blocked=[i for i in range(n) if blocked[i]],
-            line_states=line_states,
-            recent_msgs=queued,
-            invariant_violations=check_invariants(
-                self.final_dumps(), cfg, mid_flight=True
-            ),
-            counters=self.stats(),
-        )
+        return stall_diagnostic(self.config, self.state, reason)
 
     # -- parity path: per-cycle stepping with candidate capture -------
 
@@ -266,6 +214,66 @@ class JaxEngine:
         return engine_stats(self.state)
 
 
+def stall_diagnostic(
+    config: SystemConfig, st: SimState, reason: str
+) -> StallDiagnostic:
+    """Structured post-mortem from an UNBATCHED device state (mirrors
+    SpecEngine.stall_diagnostic; the JAX engine has no host-side
+    flight recorder, so "recent" messages are the still-queued mailbox
+    heads — exactly the traffic the stall left in flight).  Shared by
+    the single-system engine and the batched/sharded engines, which
+    pass the stalled system's slice — so the diagnostic is identical
+    whatever partitioning ran the system."""
+    from hpa2_tpu.utils.invariants import check_invariants
+
+    n = config.num_procs
+    mb_count = np.asarray(st.mb_count)
+    waiting = np.asarray(st.waiting)
+    blocked = np.any(np.asarray(st.ob_valid), axis=1)
+    caddr = np.asarray(st.cache_addr)
+    cval = np.asarray(st.cache_val)
+    cstate = np.asarray(st.cache_state)
+    line_states = {}
+    for i in range(n):
+        lines = []
+        for idx in range(config.cache_size):
+            a = int(caddr[i, idx])
+            if a == -1:
+                continue
+            lines.append(
+                f"[{idx}] 0x{a:02X}="
+                f"{CacheState(int(cstate[i, idx])).name}"
+                f"({int(cval[i, idx])})"
+            )
+        line_states[i] = lines
+    mb_data = np.asarray(st.mb_data)
+    queued = []
+    for i in range(n):
+        for s_i in range(min(int(mb_count[i]), 4)):
+            row = mb_data[i, s_i]
+            queued.append(
+                f"queued at node {i}[{s_i}]: from "
+                f"{int(row[MB_SENDER])} "
+                f"{MsgType(int(row[MB_TYPE])).name} "
+                f"0x{int(row[MB_ADDR]):02X}"
+            )
+    arrs = JaxEngine._live_arrays(st)
+    dumps = [_node_dump_from(arrs, i) for i in range(n)]
+    return StallDiagnostic(
+        reason=reason,
+        cycle=int(st.cycle),
+        mailbox_depths={i: int(mb_count[i]) for i in range(n)},
+        waiting=[i for i in range(n) if waiting[i]],
+        blocked=[i for i in range(n) if blocked[i]],
+        line_states=line_states,
+        recent_msgs=queued,
+        invariant_violations=check_invariants(
+            dumps, config, mid_flight=True
+        ),
+        counters=engine_stats(st),
+    )
+
+
 def format_stats(core: dict, msg_counts) -> dict:
     """Shared counter-dict shape (spec-engine key names) for all
     engines — the single place the naming lives."""
@@ -318,24 +326,36 @@ def stack_states(states: Sequence[SimState]) -> SimState:
 
 
 @functools.lru_cache(maxsize=16)
-def build_batched_run(config: SystemConfig, max_cycles: int = 1_000_000):
+def build_batched_run(config: SystemConfig, max_cycles: int = 1_000_000,
+                      watchdog_cycles: int = 0):
     """Jitted run-to-quiescence for a batch of systems.
 
     One ``lax.while_loop`` drives a vmapped step until EVERY system in
     the batch is quiescent; already-quiescent systems no-op (their
     mailboxes are empty and traces exhausted, so the step leaves them
     unchanged apart from the cycle counter).
+
+    ``watchdog_cycles`` > 0 also stops once no still-live system has
+    made progress for that many cycles (the batched analog of
+    ops/step.py's single-system watchdog), so a severed-link livelock
+    surfaces as a :class:`StallDiagnostic` instead of burning to
+    ``max_cycles``.
     """
     step = build_step(config, replay=False)
     vstep = jax.vmap(step)
     vquiet = jax.vmap(quiescent)
 
     def cond(st):
-        return (
-            jnp.any(~vquiet(st))
+        live = ~vquiet(st)
+        go = (
+            jnp.any(live)
             & jnp.all(st.cycle < max_cycles)
             & ~jnp.any(st.overflow)
         )
+        if watchdog_cycles:
+            fresh = (st.cycle - st.last_progress) < watchdog_cycles
+            go = go & jnp.any(live & fresh)
+        return go
 
     def run(st: SimState) -> SimState:
         return jax.lax.while_loop(cond, vstep, st)
@@ -374,23 +394,63 @@ def build_batched_run_chunk(config: SystemConfig, chunk: int):
 
 
 class BatchJaxEngine:
-    """An ensemble of B independent systems on one chip (vmap over the
-    batch axis)."""
+    """An ensemble of B independent systems (vmap over the batch axis).
+
+    ``data_shards`` > 1 splits the ensemble across that many local
+    devices — the same knob (name and semantics) as
+    :class:`~hpa2_tpu.parallel.sharding.DataShardedPallasEngine`, so
+    both backends scale out through one API.  The sharded run is the
+    ``shard_map(vmap(step))`` grid path (node_shards=1) and stays
+    bit-identical to the unsharded one.
+    """
 
     def __init__(
         self,
         config: SystemConfig,
         batch_traces: Sequence[Sequence[Sequence[Instr]]],
         max_cycles: int = 1_000_000,
+        data_shards: int = 1,
+        watchdog_cycles: int = 0,
     ):
         self.config = config
+        self.b = len(batch_traces)
+        self.max_cycles = max_cycles
+        self.watchdog_cycles = watchdog_cycles
+        self.data_shards = data_shards
+        self.mesh = None
         max_t = max(
             (len(tr) for traces in batch_traces for tr in traces), default=1
         )
         self.state = stack_states(
             [init_state(config, t, max_trace_len=max_t) for t in batch_traces]
         )
-        self._run = build_batched_run(config, max_cycles=max_cycles)
+        if data_shards != 1:
+            # deferred import: parallel.sharding imports this module
+            from hpa2_tpu.parallel.sharding import (
+                _place,
+                build_node_sharded_run,
+                make_mesh,
+                state_specs,
+            )
+
+            if self.b % data_shards != 0:
+                raise ValueError(
+                    f"batch {self.b} not divisible by "
+                    f"data_shards={data_shards}"
+                )
+            self.mesh = make_mesh(node_shards=1, data_shards=data_shards)
+            self.state = _place(
+                self.state, self.mesh, state_specs(batched=True)
+            )
+            self._run = build_node_sharded_run(
+                config, self.mesh, batched=True, max_cycles=max_cycles,
+                watchdog_cycles=watchdog_cycles,
+            )
+        else:
+            self._run = build_batched_run(
+                config, max_cycles=max_cycles,
+                watchdog_cycles=watchdog_cycles,
+            )
 
     def run(self) -> "BatchJaxEngine":
         st = self._run(self.state)
@@ -398,16 +458,51 @@ class BatchJaxEngine:
         self.state = st
         if bool(jnp.any(st.overflow)):
             raise StallError("internal invariant violated: mailbox overflow despite backpressure")
-        if not bool(jnp.all(jax.vmap(quiescent)(st))):
-            raise StallError("batch did not reach quiescence (livelock?)")
+        vq = np.asarray(jax.vmap(quiescent)(st))
+        if not vq.all():
+            raise self._batch_stall(vq)
         return self
 
+    def _batch_stall(self, vq: np.ndarray) -> Exception:
+        """A watchdog-tripped batch raises the structured diagnostic of
+        the first stalled system — identical to the single-system
+        engine's, whatever data partitioning ran it."""
+        st = self.state
+        b = int(np.argmin(vq))  # first non-quiescent system
+        cycle = int(np.asarray(st.cycle)[b])
+        stalled_for = cycle - int(np.asarray(st.last_progress)[b])
+        if (
+            self.watchdog_cycles
+            and cycle < self.max_cycles
+            and stalled_for >= self.watchdog_cycles
+        ):
+            st_b = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[b], st
+            )
+            return stall_diagnostic(
+                self.config, st_b,
+                "watchdog: no instruction retired and no mailbox "
+                f"drained for {stalled_for} cycles "
+                f"(system {b} of {self.b})",
+            )
+        return StallError("batch did not reach quiescence (livelock?)")
+
     def system_snapshots(self, b: int) -> List[NodeDump]:
-        st_b = jax.tree_util.tree_map(lambda x: x[b], self.state)
+        st_b = jax.tree_util.tree_map(lambda x: np.asarray(x)[b], self.state)
         arrs = JaxEngine._snap_arrays(st_b)
         return [
             _node_dump_from(arrs, i) for i in range(self.config.num_procs)
         ]
+
+    def system_final_dumps(self, b: int) -> List[NodeDump]:
+        st_b = jax.tree_util.tree_map(lambda x: np.asarray(x)[b], self.state)
+        arrs = JaxEngine._live_arrays(st_b)
+        return [
+            _node_dump_from(arrs, i) for i in range(self.config.num_procs)
+        ]
+
+    def stats(self) -> dict:
+        return engine_stats(self.state)
 
     @property
     def instructions(self) -> int:

@@ -80,6 +80,7 @@ trace time, so very wide systems pay a long compile.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -142,6 +143,34 @@ def _bits_for(n_values: int) -> int:
 
 # bits per sharer word in split-plane mode (sign-safe i32 shifts)
 _SPLIT_BPW = 31
+
+
+def choose_block(lanes: int, requested: int) -> int:
+    """Largest divisor of ``lanes`` not exceeding ``requested`` — the
+    grid tiles the lane (ensemble) axis exactly, so the block must
+    divide it.  Warns when the best divisor is under half the (capped)
+    request: a near-prime lane count silently degrading to tiny blocks
+    (b=509 -> block=1 under the old decrement loop) costs up to the
+    full lane-parallelism factor; pad the ensemble to a composite size
+    instead."""
+    cap = max(1, min(requested, lanes))
+    best = 1
+    for d in range(1, int(lanes ** 0.5) + 1):
+        if lanes % d:
+            continue
+        for c in (d, lanes // d):
+            if best < c <= cap:
+                best = c
+    if best * 2 < cap:
+        warnings.warn(
+            f"ensemble of {lanes} lanes has no block divisor near the "
+            f"requested {requested}: using block={best} (< half the "
+            "request), which costs lane parallelism; pad the batch to "
+            "a composite size (e.g. a multiple of 256)",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+    return best
 
 
 def _split_mode(config: SystemConfig) -> bool:
@@ -1808,12 +1837,7 @@ class PallasEngine:
         self.b = b
         self._interpret_active = interpret
         self._snapshots = snapshots
-        # largest divisor of the batch not exceeding the requested
-        # block (the grid tiles the ensemble axis exactly)
-        block = min(block, b)
-        while b % block != 0:
-            block -= 1
-        self.block = block
+        self.block = choose_block(b, block)
         self.cycles_per_call = cycles_per_call
 
         tr_len = tr_len.astype(np.int32)

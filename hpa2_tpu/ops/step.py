@@ -204,10 +204,13 @@ def build_step(
     nack = sem.intervention_miss_policy == "nack"
     fault = config.fault
     fault_on = fault.enabled  # static: fault-free builds add zero ops
-    if fault_on and axis_name is not None:
+    if fault_on and axis_name is not None and shards > 1:
+        # data sharding (shards == 1 on the node axis) keeps whole
+        # systems per device, so the per-system PRNG stream is intact;
+        # only an actual node split would tear it across devices
         raise ValueError(
-            "fault injection is single-shard only (the link-layer PRNG "
-            "stream is per-system, not per-shard)"
+            "fault injection is single-node-shard only (the link-layer "
+            "PRNG stream is per-system, not per-node-shard)"
         )
     drop_p = float(fault.drop)
     n_local = n // shards

@@ -1,17 +1,23 @@
 """Multi-chip parallelism: meshes, shardings, and sharded run loops."""
 
 from hpa2_tpu.parallel.sharding import (
+    DataShardedPallasEngine,
     GridEngine,
     NodeShardedEngine,
+    build_data_sharded_pallas_run,
     build_node_sharded_run,
+    make_data_mesh,
     make_mesh,
     state_specs,
 )
 
 __all__ = [
+    "DataShardedPallasEngine",
     "GridEngine",
     "NodeShardedEngine",
+    "build_data_sharded_pallas_run",
     "build_node_sharded_run",
+    "make_data_mesh",
     "make_mesh",
     "state_specs",
 ]

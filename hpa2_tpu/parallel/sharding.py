@@ -37,6 +37,7 @@ from hpa2_tpu.config import SystemConfig
 from hpa2_tpu.models.protocol import Instr
 from hpa2_tpu.models.spec_engine import StallError
 from hpa2_tpu.ops.engine import JaxEngine, _node_dump_from, stack_states
+from hpa2_tpu.ops.pallas_engine import PallasEngine, choose_block
 from hpa2_tpu.ops.state import SimState, init_state
 from hpa2_tpu.ops.step import build_step, quiescent
 from hpa2_tpu.utils.dump import NodeDump
@@ -119,6 +120,7 @@ def build_node_sharded_run(
     mesh: Mesh,
     batched: bool,
     max_cycles: int = 1_000_000,
+    watchdog_cycles: int = 0,
 ):
     """Jitted run-to-quiescence with the node axis sharded over the
     mesh's ``node`` axis (and, if ``batched``, the ensemble over
@@ -128,6 +130,12 @@ def build_node_sharded_run(
     body is the manually-sharded SPMD step (one ICI all_gather per
     cycle), while the quiescence condition is computed on the global
     view so XLA inserts the cross-device reductions itself.
+
+    ``watchdog_cycles`` > 0 adds the stall watchdog to the loop
+    condition exactly as in ops/step.py's ``build_run``: stop once no
+    still-live system has made progress for that many cycles, so the
+    host can raise a :class:`StallDiagnostic` instead of burning to
+    ``max_cycles``.
     """
     node_shards = mesh.shape["node"]
     step = build_step(
@@ -149,20 +157,30 @@ def build_node_sharded_run(
         vq = jax.vmap(quiescent)
 
         def cond(st):
-            return (
-                jnp.any(~vq(st))
+            live = ~vq(st)
+            go = (
+                jnp.any(live)
                 & jnp.all(st.cycle < max_cycles)
                 & ~jnp.any(st.overflow)
             )
+            if watchdog_cycles:
+                fresh = (st.cycle - st.last_progress) < watchdog_cycles
+                go = go & jnp.any(live & fresh)
+            return go
 
     else:
 
         def cond(st):
-            return (
+            go = (
                 (~quiescent(st))
                 & (st.cycle < max_cycles)
                 & (~st.overflow)
             )
+            if watchdog_cycles:
+                go = go & (
+                    (st.cycle - st.last_progress) < watchdog_cycles
+                )
+            return go
 
     def run(st: SimState) -> SimState:
         return jax.lax.while_loop(cond, wrapped, st)
@@ -300,3 +318,167 @@ class GridEngine:
     @property
     def instructions(self) -> int:
         return int(jnp.sum(self.state.n_instr))
+
+
+# ---------------------------------------------------------------------------
+# Data-parallel Pallas: the ensemble (lane) axis sharded over a 1-D
+# ``data`` mesh.  Unlike SimState (leading batch axis), the Pallas
+# layout keeps the ensemble LAST (TPU vector lanes), so every
+# PartitionSpec here shards the trailing axis.  Shards are fully
+# independent systems: each device runs its own block grid, HBM window
+# prefetch, and while-to-quiescence loop with ZERO cross-shard
+# collectives in the per-cycle hot loop; the only cross-shard op of a
+# whole run is the final OR-reduce of the per-shard status words
+# (tests/test_data_sharded_pallas.py pins both properties).
+# ---------------------------------------------------------------------------
+
+
+def make_data_mesh(
+    data_shards: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """A 1-D ``('data',)`` mesh for lane-axis ensemble sharding
+    (the Pallas engine has no node axis to shard — nodes live in
+    sublanes)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if data_shards is None:
+        data_shards = len(devices)
+    if data_shards < 1 or data_shards > len(devices):
+        raise ValueError(
+            f"data_shards={data_shards} outside 1..{len(devices)} "
+            "available devices"
+        )
+    return Mesh(np.array(devices[:data_shards]), ("data",))
+
+
+def _lane_spec(ndim: int) -> P:
+    """Shard the trailing (lane/ensemble) axis over ``data``."""
+    return P(*([None] * (ndim - 1)), "data")
+
+
+@functools.lru_cache(maxsize=16)
+def build_data_sharded_pallas_run(
+    config: SystemConfig,
+    shard_b: int,
+    bb: int,
+    k: int,
+    interpret: bool,
+    snapshots: bool,
+    window: int,
+    n_seg: int,
+    max_calls: int,
+    mesh: Mesh,
+    stream: bool = True,
+    ablate: frozenset = frozenset(),
+    gate: bool = True,
+):
+    """The whole-run Pallas program of ``pallas_engine._build_stream_run``
+    (or the legacy ``_build_run``) built at the per-shard lane count and
+    wrapped in ``hostenv.shard_map``: every device drives its own
+    ``shard_b``-lane run loop end to end.  The carried state is donated
+    through the jit boundary (TPU only; CPU has no donation), so HBM
+    state/trace planes are reused across trace segments and runs
+    instead of reallocated."""
+    from hpa2_tpu.ops import pallas_engine as pe
+
+    build = pe._build_stream_run if stream else pe._build_run
+    per_shard = build(
+        config, shard_b, bb, k, interpret, snapshots, window, n_seg,
+        max_calls, ablate, gate,
+    )
+    shapes = pe.state_shapes(config, snapshots)
+    state_sp = {f: _lane_spec(len(sh) + 1) for f, sh in shapes.items()}
+
+    def shard_body(state, tr, tr_len):
+        st, status = per_shard(state, tr, tr_len)
+        return st, status[None]  # one status lane per shard
+
+    wrapped = hostenv.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(state_sp, P(None, None, "data"), P(None, "data")),
+        out_specs=(state_sp, P("data")),
+        check_replication=False,
+    )
+
+    def run_all(state, tr, tr_len):
+        state, statuses = wrapped(state, tr, tr_len)
+        # the run's ONLY cross-shard communication: OR-reduce the
+        # per-shard stalled/overflow bits once, after every shard has
+        # finished its independent quiescence loop
+        stalled = jnp.any((statuses & 1) != 0)
+        overflow = jnp.any((statuses & 2) != 0)
+        return state, (
+            stalled.astype(jnp.int32) | (overflow.astype(jnp.int32) << 1)
+        )
+
+    donate = () if interpret else (0,)
+    return jax.jit(run_all, donate_argnums=donate)
+
+
+class DataShardedPallasEngine(PallasEngine):
+    """The Pallas fast path, data-parallel over the local devices.
+
+    An ensemble of B systems splits into ``data_shards`` equal lane
+    groups, one per device; each shard runs the full streamed kernel
+    (block grid, HBM prefetch, quiescence loop) independently, so
+    throughput scales with the device count while staying bit-exact
+    with the single-device :class:`PallasEngine` — same dumps, cycle
+    counts, and stall semantics (the per-shard status bits OR into the
+    same stalled/overflow word).  Construction, ``run()``, and all
+    readback accessors are inherited; only operand placement and the
+    runner differ.
+    """
+
+    def __init__(
+        self,
+        config: SystemConfig,
+        tr_op: np.ndarray,
+        tr_addr: np.ndarray,
+        tr_val: np.ndarray,
+        tr_len: np.ndarray,
+        data_shards: Optional[int] = None,
+        mesh: Optional[Mesh] = None,
+        block: int = 1024,
+        **kwargs,
+    ):
+        if mesh is None:
+            mesh = make_data_mesh(data_shards)
+        if tuple(mesh.axis_names) != ("data",):
+            raise ValueError(
+                f"need a 1-D ('data',) mesh, got axes {mesh.axis_names}"
+            )
+        shards = mesh.shape["data"]
+        b = tr_op.shape[0]
+        if b % shards != 0:
+            raise ValueError(
+                f"batch {b} not divisible by data_shards={shards}"
+            )
+        shard_b = b // shards
+        # the per-shard grid tiles shard_b lanes, so the block must
+        # divide the SHARD lane count (any divisor of it divides b,
+        # so the base class keeps the choice)
+        block = choose_block(shard_b, block)
+        super().__init__(
+            config, tr_op, tr_addr, tr_val, tr_len, block=block, **kwargs
+        )
+        self.mesh = mesh
+        self.data_shards = shards
+        self._shard_b = shard_b
+
+        def put(x):
+            return jax.device_put(
+                x, NamedSharding(mesh, _lane_spec(x.ndim))
+            )
+
+        self.state = {f: put(v) for f, v in self.state.items()}
+        self._tr_full = put(self._tr_full)
+        self._tr_len_full = put(self._tr_len_full)
+
+    def _runner(self, max_cycles: int):
+        max_calls = max(1, -(-max_cycles // self.cycles_per_call))
+        return build_data_sharded_pallas_run(
+            self.config, self._shard_b, self.block, self.cycles_per_call,
+            self._interpret, self._snapshots, self._window, self._n_seg,
+            max_calls, self.mesh, self._stream, self._ablate, self._gate,
+        )

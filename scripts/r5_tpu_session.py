@@ -22,6 +22,13 @@ Steps, in value order:
   7. block2048     — the next doubling, streaming kernel, window 8
   8. sweeps        — a few block/window/gate points around the winner
   9. scale4/scale5 — BASELINE.json configs 4-5 (scripts/scale_runs.py)
+ 10. sweep512_dp   — the shipped bench shape with the ensemble split
+                     across every local chip (DataShardedPallasEngine;
+                     shards=0 means "all devices")
+ 11. multichip     — the data_shards scaling ladder + bit-exactness
+                     check (scripts/scale_runs.py multichip), which
+                     writes MULTICHIP_r06.json with indicative:true
+                     pod-slice numbers
 
 All measure() steps run the HBM-streaming run program (PallasEngine
 default stream=True since the VMEM-wall PR).
@@ -102,22 +109,36 @@ def run_py(step, code_or_argv, timeout_s, argv=False):
 
 def measure_child(params) -> int:
     """--measure mode: one timed pallas run, one JSON line out.
-    Runs in the child interpreter (under the TPU env)."""
+    Runs in the child interpreter (under the TPU env).  An optional
+    8th parameter is the data-shard count (0 = all local devices;
+    1 = plain single-device PallasEngine)."""
     import numpy as np
 
     from hpa2_tpu.config import Semantics, SystemConfig
     from hpa2_tpu.ops.pallas_engine import PallasEngine, _SC_CYCLE
     from hpa2_tpu.utils.trace import gen_uniform_random_arrays
 
-    batch, instrs, block, k, cap, window, gate = params
+    batch, instrs, block, k, cap, window, gate = params[:7]
+    shards = params[7] if len(params) > 7 else 1
+    if shards == 0:
+        import jax
+
+        shards = len(jax.devices())
     config = SystemConfig(num_procs=8, msg_buffer_size=cap,
                           semantics=Semantics().robust())
+    if shards > 1:
+        batch = -(-batch // shards) * shards
     arrays = gen_uniform_random_arrays(config, batch, instrs, seed=0)
 
     def build():
-        return PallasEngine(config, *arrays, block=block,
-                            cycles_per_call=k, snapshots=False,
-                            trace_window=window, gate=bool(gate))
+        kw = dict(block=block, cycles_per_call=k, snapshots=False,
+                  trace_window=window, gate=bool(gate))
+        if shards > 1:
+            from hpa2_tpu.parallel.sharding import DataShardedPallasEngine
+
+            return DataShardedPallasEngine(
+                config, *arrays, data_shards=shards, **kw)
+        return PallasEngine(config, *arrays, **kw)
 
     eng = build()
     t0 = time.perf_counter()
@@ -128,7 +149,7 @@ def measure_child(params) -> int:
     eng2.run(max_cycles=5_000_000)
     dt = time.perf_counter() - t0
     cyc = int(np.max(np.asarray(eng2.state["scalars"][_SC_CYCLE])))
-    print(json.dumps({
+    rec = {
         "batch": batch, "instrs": instrs, "block": block, "k": k,
         "cap": cap, "window": window, "gate": gate,
         "instructions": eng2.instructions, "seconds": round(dt, 3),
@@ -136,14 +157,20 @@ def measure_child(params) -> int:
         "ops_per_sec": round(eng2.instructions / dt, 1),
         "cycles": cyc,
         "us_per_cycle": round(dt / max(cyc, 1) * 1e6, 2),
-    }))
+    }
+    if shards > 1:
+        rec["data_shards"] = shards
+    print(json.dumps(rec))
     return 0
 
 
 def measure(step, batch, instrs, block, k, cap, window, gate,
-            timeout_s=900):
+            timeout_s=900, shards=1):
+    params = [batch, instrs, block, k, cap, window, gate]
+    if shards != 1:
+        params.append(shards)
     argv = [os.path.abspath(__file__), "--measure"] + [
-        str(x) for x in (batch, instrs, block, k, cap, window, gate)
+        str(x) for x in params
     ]
     return run_py(step, argv, timeout_s, argv=True)
 
@@ -174,6 +201,10 @@ def _write_tuning(since: str):
                     and r.get("batch") == 32768
                     and r.get("instrs") == 128
                     and r.get("cap") == 16
+                    # data-sharded sweeps measure a different thing
+                    # (per-chip throughput x chips); the tuning file
+                    # feeds the single-engine bench shape
+                    and not r.get("data_shards")
                 ):
                     if (
                         best is None
@@ -211,7 +242,7 @@ _PROBE_CODE = (
 
 def main() -> int:
     if sys.argv[1:2] == ["--measure"]:
-        return measure_child([int(x) for x in sys.argv[2:9]])
+        return measure_child([int(x) for x in sys.argv[2:10]])
     session_start = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     skip = set()
     for i, a in enumerate(sys.argv):
@@ -309,6 +340,21 @@ def main() -> int:
         note(run_py(
             "scale5",
             [os.path.join(REPO, "scripts", "scale_runs.py"), "5"],
+            timeout_s=1800, argv=True))
+
+    if "sweep512_dp" not in skip and gate("sweep512_dp"):
+        # the shipped shape with the ensemble split across every local
+        # chip (shards=0 = all devices) — the per-chip multiplier is
+        # this row's ops_per_sec over sweep512's
+        note(measure("sweep512_dp", 32768, 128, 512, 128, 16, 32, 1,
+                     shards=0))
+    if "multichip" not in skip and gate("multichip"):
+        # full data_shards ladder + bit-exactness gate; rewrites
+        # MULTICHIP_r06.json with indicative:true pod-slice numbers
+        note(run_py(
+            "multichip",
+            [os.path.join(REPO, "scripts", "scale_runs.py"),
+             "multichip"],
             timeout_s=1800, argv=True))
     return 0
 

@@ -6,6 +6,15 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
+# Virtual 8-device CPU mesh so the multi-chip tests (virtual_mesh
+# marker) run in tier-1 on any host.  Conftest's re-exec honors an
+# existing device-count flag, so exporting here makes the mesh
+# explicit rather than relying on the re-exec default; a pre-set
+# count is respected (the marked tests skip cleanly if it is < 8).
+case "${XLA_FLAGS:-}" in
+  *xla_force_host_platform_device_count*) ;;
+  *) export XLA_FLAGS="--xla_force_host_platform_device_count=8${XLA_FLAGS:+ $XLA_FLAGS}" ;;
+esac
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log

@@ -1,10 +1,21 @@
-"""BASELINE.json configs 4-5 at full size on the real TPU.
+"""BASELINE.json configs 4-5 at full size on the real TPU, plus the
+multi-device ensemble-scaling ladder.
 
-  config4: 256-node x ~1M-instr producer-consumer trace (8 sharer
-           words — the scaling analog of the reference's 1-byte
-           bitVector cap, assignment.c:49) on the XLA engine.
-  config5: 1024-system ensemble x 10K instrs/core uniform-random on
-           the Pallas engine (windowed traces).
+  config4:   256-node x ~1M-instr producer-consumer trace (8 sharer
+             words — the scaling analog of the reference's 1-byte
+             bitVector cap, assignment.c:49) on the XLA engine.
+  config5:   1024-system ensemble x 10K instrs/core uniform-random on
+             the Pallas engine (windowed traces); ``--data-shards N``
+             splits the ensemble over N local devices
+             (DataShardedPallasEngine).
+  multichip: the data_shards ladder (1..all local devices) on one
+             fixed ensemble, with a bit-exactness check of the
+             sharded final state against the single-device run —
+             writes MULTICHIP_r06.json.  On a CPU host it re-execs
+             itself onto the virtual 8-device mesh and tags the
+             numbers ``indicative: false`` (virtual devices share the
+             host's cores; only the partition evidence transfers, the
+             wall-clock does not).
 
 Prints one JSON line per config for PERF.md.
 """
@@ -14,6 +25,8 @@ import sys
 import time
 
 sys.path.insert(0, "/root/repo")
+
+_MULTICHIP_PATH = "/root/repo/MULTICHIP_r06.json"
 
 
 def config4(instrs_per_core=4096):
@@ -51,11 +64,22 @@ def config4(instrs_per_core=4096):
     }), flush=True)
 
 
-def config5(batch=1024, instrs_per_core=10_000):
+def _build_pallas(config, arrays, data_shards, **kw):
+    if data_shards > 1:
+        from hpa2_tpu.parallel.sharding import DataShardedPallasEngine
+
+        return DataShardedPallasEngine(
+            config, *arrays, data_shards=data_shards, **kw)
+    from hpa2_tpu.ops.pallas_engine import PallasEngine
+
+    return PallasEngine(config, *arrays, **kw)
+
+
+def config5(batch=1024, instrs_per_core=10_000, data_shards=1):
     import numpy as np
 
     from hpa2_tpu.config import Semantics, SystemConfig
-    from hpa2_tpu.ops.pallas_engine import PallasEngine, _SC_CYCLE
+    from hpa2_tpu.ops.pallas_engine import _SC_CYCLE
     from hpa2_tpu.utils.trace import gen_uniform_random_arrays
 
     config = SystemConfig(
@@ -63,11 +87,11 @@ def config5(batch=1024, instrs_per_core=10_000):
         semantics=Semantics().robust(),
     )
     arrays = gen_uniform_random_arrays(config, batch, instrs_per_core)
+    kw = dict(block=512, cycles_per_call=128, snapshots=False,
+              trace_window=32)
 
     def build():
-        return PallasEngine(config, *arrays, block=512,
-                            cycles_per_call=128, snapshots=False,
-                            trace_window=32)
+        return _build_pallas(config, arrays, data_shards, **kw)
 
     build().run(max_cycles=5_000_000)  # compile + warm
     eng = build()
@@ -75,18 +99,121 @@ def config5(batch=1024, instrs_per_core=10_000):
     eng.run(max_cycles=5_000_000)
     dt = time.perf_counter() - t0
     cycles = int(np.max(np.asarray(eng.state["scalars"][_SC_CYCLE])))
-    print(json.dumps({
+    rec = {
         "config": "5: 1024-system x 10K-instr ensemble (pallas)",
         "nodes": 8, "batch": batch,
         "instructions": eng.instructions, "cycles": cycles,
         "seconds": round(dt, 2),
         "ops_per_sec": round(eng.instructions / dt, 1),
-    }), flush=True)
+    }
+    if data_shards != 1:
+        rec["data_shards"] = data_shards
+    print(json.dumps(rec), flush=True)
+
+
+def multichip(batch=32, instrs_per_core=32):
+    """The data_shards scaling ladder for MULTICHIP_r06.json.
+
+    On a real TPU slice the per-shard wall-clock is the pod-scaling
+    headline; on CPU the 8 virtual devices share the host's physical
+    cores, so only the structure (balanced partition + bit-exact
+    state) is evidence and the record says ``indicative: false``.
+    CPU interpret mode is also slow, so the CPU ladder runs a small
+    ensemble.
+    """
+    import jax
+    import numpy as np
+
+    from hpa2_tpu.config import Semantics, SystemConfig
+    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+    platform = jax.devices()[0].platform
+    on_tpu = any("tpu" in str(d).lower() for d in jax.devices())
+    n_dev = len(jax.devices())
+    if not on_tpu and n_dev < 8:
+        # CPU-only host: restart this process onto the virtual
+        # 8-device mesh (exec replaces the image, so the stale jax
+        # backend in THIS interpreter doesn't matter); no-op if the
+        # flag was already set or we already re-execed
+        from hpa2_tpu.hostenv import reexec_with_virtual_mesh
+
+        reexec_with_virtual_mesh(8)
+    if on_tpu:
+        batch, instrs_per_core = 32768, 128
+    config = SystemConfig(
+        num_procs=8, msg_buffer_size=16, max_instr_num=0,
+        semantics=Semantics().robust(),
+    )
+    arrays = gen_uniform_random_arrays(config, batch, instrs_per_core)
+    kw = dict(block=512, cycles_per_call=128, snapshots=False,
+              trace_window=32)
+
+    ladder = [s for s in (1, 2, 4, 8, 16, 32) if s <= n_dev]
+    rows = []
+    ref_state = None
+    bit_exact = True
+    for shards in ladder:
+        def build():
+            return _build_pallas(config, arrays, shards, **kw)
+
+        build().run(max_cycles=5_000_000)  # compile + warm
+        eng = build()
+        t0 = time.perf_counter()
+        eng.run(max_cycles=5_000_000)
+        dt = time.perf_counter() - t0
+        if ref_state is None:
+            ref_state = {f: np.asarray(v) for f, v in eng.state.items()}
+        else:
+            bit_exact = bit_exact and all(
+                np.array_equal(ref_state[f], np.asarray(v))
+                for f, v in eng.state.items()
+            )
+        rows.append({
+            "data_shards": shards,
+            "instructions": eng.instructions,
+            "seconds": round(dt, 3),
+            "ops_per_sec": round(eng.instructions / dt, 1),
+        })
+        print(json.dumps({"multichip_step": rows[-1]}), flush=True)
+
+    base = rows[0]["ops_per_sec"]
+    record = {
+        "metric": "pallas_data_parallel_scaling",
+        "unit": "RD/WR ops/sec",
+        "platform": platform,
+        "n_devices": n_dev,
+        # CPU virtual-mesh wall-clock is NOT a scaling headline
+        # (devices share the host cores) — same convention as the
+        # bench's CPU smoke
+        "indicative": on_tpu,
+        "batch": batch,
+        "instrs_per_core": instrs_per_core,
+        "bit_exact_vs_single_device": bool(bit_exact),
+        "shards": rows,
+        "speedup_at_max_shards": round(rows[-1]["ops_per_sec"] / base, 2)
+        if base else None,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(_MULTICHIP_PATH, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print(json.dumps(record), flush=True)
+    assert bit_exact, "sharded run diverged from single-device state"
+
+
+def _arg_int(name, default):
+    if name in sys.argv:
+        return int(sys.argv[sys.argv.index(name) + 1])
+    return default
 
 
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    if which == "multichip":
+        multichip()
+        sys.exit(0)
+    shards = _arg_int("--data-shards", 1)
     if which in ("4", "both"):
         config4()
     if which in ("5", "both"):
-        config5()
+        config5(data_shards=shards)

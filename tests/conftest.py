@@ -20,6 +20,13 @@ def pytest_configure(config):
         "slow: excluded from the tier-1 gate (-m 'not slow'); e.g. the "
         "TSan bench in tests/test_sanitizers.py",
     )
+    config.addinivalue_line(
+        "markers",
+        "virtual_mesh: needs the 8-device virtual CPU mesh "
+        "(XLA_FLAGS=--xla_force_host_platform_device_count=8); skipped "
+        "cleanly when the flag could not take effect, e.g. jax was "
+        "initialized before it was set",
+    )
     # The axon sitecustomize registers the TPU PJRT plugin at
     # interpreter startup and pins the backend, so an in-process
     # JAX_PLATFORMS override is too late — re-exec once with a clean
@@ -59,3 +66,24 @@ def reference_tests_dir():
     if not REFERENCE_TESTS.is_dir():
         pytest.skip("reference test corpus not available")
     return REFERENCE_TESTS
+
+
+def pytest_collection_modifyitems(config, items):
+    """``virtual_mesh``-marked tests skip cleanly when the 8-device
+    mesh is unavailable — the device-count XLA flag cannot take effect
+    once jax has initialized its backend (e.g. a stale interpreter, or
+    a host that pinned XLA_FLAGS to something else)."""
+    if not any(i.get_closest_marker("virtual_mesh") for i in items):
+        return
+    import jax
+
+    n = len(jax.devices())
+    if n >= 8:
+        return
+    skip = pytest.mark.skip(
+        reason=f"virtual 8-device mesh unavailable ({n} device(s); the "
+        "device-count flag did not take effect)"
+    )
+    for item in items:
+        if item.get_closest_marker("virtual_mesh"):
+            item.add_marker(skip)

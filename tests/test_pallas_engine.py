@@ -307,3 +307,52 @@ def test_pallas_deterministic_fixture_parity(reference_tests_dir, suite):
         assert format_processor_state(nd, cfg) == want, (
             f"{suite} core_{nd.proc_id}"
         )
+
+
+# -- block auto-shrink ------------------------------------------------
+
+
+class TestChooseBlock:
+    """The engine needs block | b for an even grid.  The old shrink
+    loop walked down silently — a prime batch of 509 quietly ran at
+    block=1 (509 sequential grid steps, no lane parallelism).  The
+    divisor is still chosen automatically, but a severe shrink (< half
+    the request) now warns."""
+
+    def test_prime_batch_warns_and_degrades_to_1(self):
+        from hpa2_tpu.ops.pallas_engine import choose_block
+
+        with pytest.warns(RuntimeWarning, match="block divisor"):
+            assert choose_block(509, 256) == 1
+
+    def test_exact_divisor_is_silent(self):
+        import warnings
+
+        from hpa2_tpu.ops.pallas_engine import choose_block
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert choose_block(509, 509) == 509
+            assert choose_block(512, 256) == 256
+            assert choose_block(1024, 4096) == 1024  # capped at b
+
+    def test_mild_shrink_is_silent(self):
+        import warnings
+
+        from hpa2_tpu.ops.pallas_engine import choose_block
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            # 6 is the largest divisor of 12 <= 8: a mild (>= half)
+            # shrink, not worth a warning
+            assert choose_block(12, 8) == 6
+
+    def test_engine_surfaces_the_warning(self):
+        # the b=509 regression, end to end through __init__
+        cfg = SystemConfig(num_procs=4,
+                           semantics=Semantics().robust())
+        arrays = gen_uniform_random_arrays(cfg, 509, 4, seed=0)
+        with pytest.warns(RuntimeWarning, match="lane parallelism"):
+            eng = PallasEngine(cfg, *arrays, block=256)
+        assert eng.block == 1
+        assert eng.b % eng.block == 0
