@@ -90,6 +90,19 @@ def _data_shards() -> int:
         return 1
 
 
+def _trace_len_dist():
+    """Heterogeneous-workload knob (``--trace-len-dist``): returns
+    (dist, spread) or (None, spread) for the default homogeneous
+    uniform-random traces.  Carried to the children through the
+    environment, like ``--data-shards``."""
+    dist = os.environ.get("HPA2_BENCH_TRACE_DIST", "").strip() or None
+    try:
+        spread = float(os.environ.get("HPA2_BENCH_TRACE_SPREAD", "8"))
+    except ValueError:
+        spread = 8.0
+    return dist, max(1.0, spread)
+
+
 # ---------------------------------------------------------------------------
 # children (each runs in its own interpreter under a known-good env)
 # ---------------------------------------------------------------------------
@@ -129,13 +142,38 @@ def compile_gate_main() -> int:
     return 0
 
 
-def bench_pallas(config, batch, instrs_per_core, seed=0, data_shards=1):
+def bench_pallas(config, batch, instrs_per_core, seed=0, data_shards=1,
+                 dist=None, spread=8.0):
     from hpa2_tpu.ops.pallas_engine import PallasEngine
-    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+    from hpa2_tpu.utils.trace import (gen_heterogeneous_random_arrays,
+                                      gen_uniform_random_arrays)
 
-    arrays = gen_uniform_random_arrays(config, batch, instrs_per_core,
-                                       seed=seed)
     block, window, k, gate = _tuned_shape()
+    occupancy = None
+    if dist:
+        arrays = gen_heterogeneous_random_arrays(
+            config, batch, instrs_per_core, dist=dist, spread=spread,
+            seed=seed)
+        # static occupancy model over the SAME lengths the generator
+        # drew (shared helper, same seed): mean live-lane fraction and
+        # block-segments vs the lockstep bound at the tuned kernel
+        # shape.  The model replays the engines' exact barrier policy
+        # (see hpa2_tpu/analysis/occupancy.py), so this is what
+        # ``schedule=`` would save — recorded in the artifact without
+        # perturbing the measured run.
+        from hpa2_tpu.analysis.occupancy import predicted_stats
+        from hpa2_tpu.ops.pallas_engine import choose_block
+        from hpa2_tpu.utils.trace import heterogeneous_lengths
+
+        lens = heterogeneous_lengths(batch, instrs_per_core,
+                                     dist=dist, spread=spread, seed=seed)
+        occupancy = predicted_stats(
+            lens, window, choose_block(batch // data_shards, block),
+            groups=data_shards,
+        ).as_dict()
+    else:
+        arrays = gen_uniform_random_arrays(config, batch,
+                                           instrs_per_core, seed=seed)
 
     if data_shards > 1:
         from hpa2_tpu.parallel.sharding import DataShardedPallasEngine
@@ -157,7 +195,7 @@ def bench_pallas(config, batch, instrs_per_core, seed=0, data_shards=1):
     t0 = time.perf_counter()
     eng.run()
     dt = time.perf_counter() - t0
-    return eng.instructions, dt
+    return eng.instructions, dt, occupancy
 
 
 def bench_jax(config, batch, instrs_per_core, seed=0):
@@ -212,14 +250,16 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
     if batch % shards:  # the ensemble splits into equal lane groups
         batch = -(-batch // shards) * shards
 
+    dist, spread = _trace_len_dist()
     engine = "pallas"
     err = pallas_error
     ran_ok = False
+    occupancy = None
     if pallas_ok or not on_tpu:  # CPU always tries interpret mode
         try:
-            jax_instrs, jax_dt = bench_pallas(config, batch,
-                                              instrs_per_core,
-                                              data_shards=shards)
+            jax_instrs, jax_dt, occupancy = bench_pallas(
+                config, batch, instrs_per_core, data_shards=shards,
+                dist=dist, spread=spread)
             ran_ok = True
         except Exception as e:  # noqa: BLE001
             err = str(e)[-300:]
@@ -246,6 +286,10 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
         "jax_instrs": jax_instrs,
         "jax_seconds": round(jax_dt, 4),
     }
+    if dist:
+        result["trace_len_dist"] = {"dist": dist, "spread": spread}
+        if occupancy is not None:
+            result["occupancy"] = occupancy
     if shards != 1:
         import jax
 
@@ -392,7 +436,8 @@ def _compile_gate():
         )
     except subprocess.TimeoutExpired:
         return False, f"compile gate timeout ({_COMPILE_GATE_TIMEOUT_S}s)"
-    sys.stderr.write(proc.stderr.decode(errors="replace")[-2000:])
+    sys.stderr.write(
+        _filter_xla_spew(proc.stderr.decode(errors="replace"))[-2000:])
     for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -402,6 +447,20 @@ def _compile_gate():
                 continue
             return bool(rec.get("ok")), rec.get("error", "")
     return False, f"compile gate rc={proc.returncode}, no JSON"
+
+
+def _filter_xla_spew(text: str) -> str:
+    """Drop XLA's host-CPU-feature-mismatch warning (a multi-KB dump
+    of +avx512.../-amx... flags ending in "...such as SIGILL") from a
+    child's relayed stderr.  It fires on every CPU smoke run, carries
+    no signal for this workload, and used to dominate the BENCH_*.json
+    ``tail`` the artifact driver captures — burying the one JSON line
+    the tail exists to show."""
+    markers = ("host machine features", "cpu_feature_guard",
+               "errors such as SIGILL")
+    kept = [ln for ln in text.splitlines()
+            if not any(m in ln for m in markers)]
+    return "\n".join(kept) + ("\n" if kept else "")
 
 
 def _run_child(platform: str, timeout_s: int, pallas_ok: bool,
@@ -430,7 +489,7 @@ def _run_child(platform: str, timeout_s: int, pallas_ok: bool,
         print(f"{platform} bench child: timeout ({timeout_s}s)",
               file=sys.stderr)
         return None
-    sys.stderr.write(proc.stderr.decode(errors="replace"))
+    sys.stderr.write(_filter_xla_spew(proc.stderr.decode(errors="replace")))
     for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -462,6 +521,30 @@ def main() -> int:
             )
         except (IndexError, ValueError):
             print("usage: bench.py [--data-shards N]", file=sys.stderr)
+            return 2
+    if "--trace-len-dist" in sys.argv:
+        # heterogeneous per-system trace lengths (uniform|zipf over
+        # [max/spread, max]); the artifact then also carries the static
+        # occupancy model's stats for the generated length distribution
+        i = sys.argv.index("--trace-len-dist")
+        try:
+            dist = sys.argv[i + 1]
+            if dist not in ("uniform", "zipf"):
+                raise ValueError(dist)
+            os.environ["HPA2_BENCH_TRACE_DIST"] = dist
+        except (IndexError, ValueError):
+            print("usage: bench.py [--trace-len-dist uniform|zipf]",
+                  file=sys.stderr)
+            return 2
+    if "--trace-len-spread" in sys.argv:
+        i = sys.argv.index("--trace-len-spread")
+        try:
+            os.environ["HPA2_BENCH_TRACE_SPREAD"] = str(
+                float(sys.argv[i + 1])
+            )
+        except (IndexError, ValueError):
+            print("usage: bench.py [--trace-len-spread RATIO]",
+                  file=sys.stderr)
             return 2
 
     tpu_ok = _probe_tpu()

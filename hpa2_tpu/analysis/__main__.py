@@ -7,6 +7,7 @@ Subcommands::
     python -m hpa2_tpu.analysis equiv          # cross-backend table diff
     python -m hpa2_tpu.analysis mutation-test  # analyzer self-test
     python -m hpa2_tpu.analysis vmem           # static VMEM budget model
+    python -m hpa2_tpu.analysis occupancy      # occupancy scheduler model
 
 ``check`` is the cheap gate (pure Python, no JAX import): whole-table
 static checks plus the spec-engine equivalence diff, on both the
@@ -120,6 +121,25 @@ def cmd_vmem(args: argparse.Namespace) -> int:
     return 0 if worst.fits else 1
 
 
+def cmd_occupancy(args: argparse.Namespace) -> int:
+    from hpa2_tpu.analysis.occupancy import occupancy_table
+
+    table, rc = occupancy_table(
+        args.batch, args.instrs, args.window, args.block,
+        dists=[d.strip() for d in args.dists.split(",") if d.strip()],
+        spreads=tuple(float(s) for s in args.spreads.split(",")),
+        threshold=args.threshold,
+        resident=args.resident,
+        groups=args.groups,
+        seed=args.seed,
+    )
+    print(table)
+    if rc:
+        print("MODEL VIOLATION: scheduler predicted to exceed the "
+              "lockstep bound")
+    return rc
+
+
 def cmd_mutation_test(args: argparse.Namespace) -> int:
     from hpa2_tpu.analysis.mutate import run_all_mutations
 
@@ -164,6 +184,23 @@ def main(argv=None) -> int:
                     help="mailbox capacity (msg_buffer_size)")
     vp.add_argument("--snapshots", action="store_true")
     vp.add_argument("--gate", action="store_true")
+    op = sub.add_parser("occupancy", help="occupancy scheduler model")
+    op.add_argument("--batch", type=int, default=64)
+    op.add_argument("--instrs", type=int, default=96,
+                    help="longest per-core trace (max_instrs)")
+    op.add_argument("--window", type=int, default=16)
+    op.add_argument("--block", type=int, default=16)
+    op.add_argument("--dists", default="uniform,zipf",
+                    help="comma-separated: uniform,zipf")
+    op.add_argument("--spreads", default="2,4,8",
+                    help="comma-separated max/min length ratios")
+    op.add_argument("--threshold", type=float, default=0.5,
+                    help="compaction occupancy threshold")
+    op.add_argument("--resident", type=int, default=None,
+                    help="device-resident lanes (default: whole batch)")
+    op.add_argument("--groups", type=int, default=1,
+                    help="scheduling groups (data shards)")
+    op.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
     args.sem = [s.strip() for s in args.sem.split(",") if s.strip()]
     for s in args.sem:
@@ -180,6 +217,7 @@ def main(argv=None) -> int:
         "equiv": cmd_equiv,
         "mutation-test": cmd_mutation_test,
         "vmem": cmd_vmem,
+        "occupancy": cmd_occupancy,
     }[args.cmd](args)
 
 

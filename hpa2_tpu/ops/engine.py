@@ -402,6 +402,21 @@ class BatchJaxEngine:
     both backends scale out through one API.  The sharded run is the
     ``shard_map(vmap(step))`` grid path (node_shards=1) and stays
     bit-identical to the unsharded one.
+
+    ``schedule=Schedule(...)`` turns on the occupancy scheduler: the
+    run becomes a host loop of ``schedule.interval``-cycle chunks
+    (``build_batched_run_chunk``, the checkpointing granule), and at
+    each chunk barrier quiesced rows are harvested and backfilled from
+    an admission queue of not-yet-resident systems
+    (``schedule.resident < b`` streams the ensemble through the
+    device).  Per-system dumps and activity counters are bit-exact
+    versus the unscheduled run — including with fault injection, since
+    each system carries its own ``rng_key`` seeded independently of
+    batch position — but per-system ``cycle`` is NOT schedule
+    invariant here (the vmapped step ticks it unconditionally until
+    its cohort drains).  Requires ``snapshots`` semantics unchanged;
+    ``self.occupancy`` holds the
+    :class:`~hpa2_tpu.ops.schedule.OccupancyStats` after the run.
     """
 
     def __init__(
@@ -411,6 +426,7 @@ class BatchJaxEngine:
         max_cycles: int = 1_000_000,
         data_shards: int = 1,
         watchdog_cycles: int = 0,
+        schedule=None,
     ):
         self.config = config
         self.b = len(batch_traces)
@@ -418,20 +434,15 @@ class BatchJaxEngine:
         self.watchdog_cycles = watchdog_cycles
         self.data_shards = data_shards
         self.mesh = None
+        self.schedule = schedule
+        self.occupancy = None
         max_t = max(
             (len(tr) for traces in batch_traces for tr in traces), default=1
         )
-        self.state = stack_states(
-            [init_state(config, t, max_trace_len=max_t) for t in batch_traces]
-        )
+        self._max_t = max_t
         if data_shards != 1:
             # deferred import: parallel.sharding imports this module
-            from hpa2_tpu.parallel.sharding import (
-                _place,
-                build_node_sharded_run,
-                make_mesh,
-                state_specs,
-            )
+            from hpa2_tpu.parallel.sharding import make_mesh
 
             if self.b % data_shards != 0:
                 raise ValueError(
@@ -439,6 +450,35 @@ class BatchJaxEngine:
                     f"data_shards={data_shards}"
                 )
             self.mesh = make_mesh(node_shards=1, data_shards=data_shards)
+        if schedule is not None:
+            self._resident = schedule.resident or self.b
+            if not (0 < self._resident <= self.b):
+                raise ValueError(
+                    f"schedule.resident={schedule.resident} outside "
+                    f"1..{self.b}"
+                )
+            if self._resident % data_shards or self.b % data_shards:
+                raise ValueError(
+                    f"schedule.resident={self._resident} and batch "
+                    f"{self.b} must divide data_shards={data_shards}"
+                )
+            # resident rows are built lazily in _run_scheduled; the
+            # full-ensemble state exists only after the run (in system
+            # order, reconstructed from the harvest store)
+            self._batch_traces = list(batch_traces)
+            self.state = None
+            self._run = None
+            return
+        self.state = stack_states(
+            [init_state(config, t, max_trace_len=max_t) for t in batch_traces]
+        )
+        if data_shards != 1:
+            from hpa2_tpu.parallel.sharding import (
+                _place,
+                build_node_sharded_run,
+                state_specs,
+            )
+
             self.state = _place(
                 self.state, self.mesh, state_specs(batched=True)
             )
@@ -453,6 +493,8 @@ class BatchJaxEngine:
             )
 
     def run(self) -> "BatchJaxEngine":
+        if self.schedule is not None:
+            return self._run_scheduled()
         st = self._run(self.state)
         st = jax.tree_util.tree_map(lambda x: x.block_until_ready(), st)
         self.state = st
@@ -461,6 +503,101 @@ class BatchJaxEngine:
         vq = np.asarray(jax.vmap(quiescent)(st))
         if not vq.all():
             raise self._batch_stall(vq)
+        return self
+
+    def _run_scheduled(self) -> "BatchJaxEngine":
+        from collections import deque
+
+        from hpa2_tpu.ops.schedule import OccupancyStats
+
+        cfg = self.config
+        r = self._resident
+        chunk = max(1, self.schedule.interval)
+        runner = build_batched_run_chunk(cfg, chunk)
+        vq = jax.vmap(quiescent)
+        if self.mesh is not None:
+            from hpa2_tpu.parallel.sharding import _place, state_specs
+
+            place = lambda st: _place(
+                st, self.mesh, state_specs(batched=True)
+            )
+        else:
+            place = lambda st: st
+
+        def fresh(s):
+            return init_state(
+                cfg, self._batch_traces[s], max_trace_len=self._max_t
+            )
+
+        # contiguous group partition, mirroring the Pallas scheduler:
+        # each data shard owns a contiguous slice of rows and systems
+        # and never exchanges work with its neighbors
+        groups = self.data_shards
+        gl, gs = r // groups, self.b // groups
+        row_sys = np.full(r, -1, dtype=np.int64)
+        queues = []
+        for g in range(groups):
+            row_sys[g * gl:(g + 1) * gl] = np.arange(
+                g * gs, g * gs + gl
+            )
+            queues.append(deque(range(g * gs + gl, (g + 1) * gs)))
+        st = place(stack_states([fresh(s) for s in row_sys]))
+        store: list = [None] * self.b
+        stats = OccupancyStats()
+        row_age = np.zeros(r, dtype=np.int64)  # cycles since admission
+        while (row_sys >= 0).any():
+            live = row_sys >= 0
+            stats.intervals += 1
+            stats.live_lane_intervals += int(live.sum())
+            stats.lane_intervals += r
+            stats.block_segments += int(live.sum())
+            st = runner(st)
+            row_age += chunk
+            if bool(jnp.any(st.overflow)):
+                raise StallError(
+                    "internal invariant violated: mailbox overflow "
+                    "despite backpressure"
+                )
+            q = np.asarray(vq(st))
+            for row in np.nonzero(live & q)[0]:
+                store[row_sys[row]] = jax.tree_util.tree_map(
+                    lambda x: np.asarray(x)[row], st
+                )
+                row_sys[row] = -1
+            stuck = (row_sys >= 0) & ~q & (row_age > self.max_cycles)
+            if stuck.any():
+                row = int(np.argmax(stuck))
+                st_row = jax.tree_util.tree_map(
+                    lambda x: np.asarray(x)[row], st
+                )
+                raise stall_diagnostic(
+                    cfg, st_row,
+                    f"no quiescence within {self.max_cycles} cycles "
+                    f"(system {int(row_sys[row])} of {self.b}, "
+                    "scheduled run)",
+                )
+            repl = []
+            for g in range(groups):
+                qd = queues[g]
+                for row in range(g * gl, (g + 1) * gl):
+                    if not qd:
+                        break
+                    if row_sys[row] < 0:
+                        s = qd.popleft()
+                        row_sys[row] = s
+                        row_age[row] = 0
+                        repl.append((row, s))
+            if repl:
+                stats.admissions += len(repl)
+                init_b = stack_states([fresh(s) for _, s in repl])
+                idx = jnp.asarray(np.array([row for row, _ in repl]))
+                st = place(jax.tree_util.tree_map(
+                    lambda a, v: a.at[idx].set(v), st, init_b
+                ))
+        # invert the row->system assignment history: full-ensemble
+        # state in system order, so all readback works unchanged
+        self.state = place(stack_states(store))
+        self.occupancy = stats
         return self
 
     def _batch_stall(self, vq: np.ndarray) -> Exception:

@@ -1807,6 +1807,21 @@ class PallasEngine:
     which is what lets block 1024/2048 fit under the 16 MB cap.
     ``stream=False`` keeps the legacy host-composed window loop with
     the fully VMEM-resident per-call kernel.
+
+    ``schedule=Schedule(...)`` turns on the occupancy scheduler
+    (hpa2_tpu/ops/schedule.py): the run becomes a host loop of
+    single-segment intervals of the SAME run program (``n_seg=1``, so
+    the cycle-loop body is bit-identical), and at each segment barrier
+    finished lanes are harvested, freed lanes are backfilled from an
+    admission queue of not-yet-resident systems
+    (``schedule.resident < b`` streams the ensemble through the
+    device), and under-occupied blocks are gather-compacted so whole
+    blocks quiesce and skip.  Per-system results are bit-exact versus
+    the unscheduled run — systems are independent along the lane axis
+    and every per-system counter (including ``_SC_CYCLE``, which only
+    accrues while a lane is active) is schedule-invariant.  Requires
+    ``snapshots=False``; ``self.occupancy`` holds the measured
+    :class:`~hpa2_tpu.ops.schedule.OccupancyStats` after the run.
     """
 
     def __init__(
@@ -1823,6 +1838,7 @@ class PallasEngine:
         trace_window: Optional[int] = None,
         gate: bool = True,
         stream: bool = True,
+        schedule=None,
         _ablate: frozenset = frozenset(),
     ):
         if interpret is None:
@@ -1837,7 +1853,28 @@ class PallasEngine:
         self.b = b
         self._interpret_active = interpret
         self._snapshots = snapshots
-        self.block = choose_block(b, block)
+        self.schedule = schedule
+        self.occupancy = None
+        if schedule is not None:
+            if snapshots:
+                raise ValueError(
+                    "the occupancy scheduler reorders and reuses lanes;"
+                    " dump-at-local-completion snapshots are defined on"
+                    " the whole-trace lockstep run — build with"
+                    " snapshots=False"
+                )
+            self._resident = schedule.resident or b
+            if not (0 < self._resident <= b):
+                raise ValueError(
+                    f"schedule.resident={schedule.resident} outside "
+                    f"1..{b}"
+                )
+            # the device carries `resident` lanes, so the grid tiles
+            # that lane count, not the full ensemble
+            self.block = choose_block(self._resident, block)
+        else:
+            self._resident = b
+            self.block = choose_block(b, block)
         self.cycles_per_call = cycles_per_call
 
         tr_len = tr_len.astype(np.int32)
@@ -1854,10 +1891,17 @@ class PallasEngine:
         t_pad = self._n_seg * w
         if t_pad != t:
             packed = np.pad(packed, ((0, 0), (0, t_pad - t), (0, 0)))
+        tr_len_nb = np.ascontiguousarray(np.moveaxis(tr_len, 0, 1))
+        if schedule is not None:
+            from hpa2_tpu.ops.schedule import segments_needed
+
+            # host-side copies drive per-interval window assembly
+            self._tr_np = packed
+            self._tr_len_np = tr_len_nb
+            self._nseg = segments_needed(tr_len_nb, w)
+            self._sched_groups = 1
         self._tr_full = jnp.asarray(packed)
-        self._tr_len_full = jnp.asarray(
-            np.ascontiguousarray(np.moveaxis(tr_len, 0, 1))
-        )
+        self._tr_len_full = jnp.asarray(tr_len_nb)
         state = _init_state(config, b, snapshots)
         self.state = {f: jnp.asarray(v) for f, v in state.items()}
         # first-window traces, for direct _call users (perf tooling)
@@ -1885,6 +1929,138 @@ class PallasEngine:
             max_calls, self._ablate, self._gate,
         )
 
+    # -- occupancy scheduling (hpa2_tpu/ops/schedule.py) --------------
+
+    def _interval_runner(self, max_cycles: int):
+        """One scheduling interval = the UNSCHEDULED run program built
+        at ``n_seg=1`` over the resident lanes — the lru_cache returns
+        the identical object an unscheduled single-segment engine gets,
+        so scheduling provably adds zero ops to the cycle loop
+        (tests/test_occupancy.py pins the identity)."""
+        max_calls = max(1, -(-max_cycles // self.cycles_per_call))
+        build = _build_stream_run if self._stream else _build_run
+        return build(
+            self.config, self._resident, self.block,
+            self.cycles_per_call, self._interpret, False, self._window,
+            1, max_calls, self._ablate, self._gate,
+        )
+
+    def _sched_put(self, x):
+        """Operand placement hook for the scheduled path (the sharded
+        subclass pins the lane axis to the mesh)."""
+        return x
+
+    def _barrier_fn(self):
+        """Jitted segment-barrier transform: gather-permute every
+        carried plane along the lane axis, then reset newly admitted
+        lanes to the (system-independent) init state.  This is the ONLY
+        program that touches lanes outside the run kernel — compaction
+        ops live here, never in the cycle loop."""
+        cached = getattr(self, "_barrier_cache", None)
+        if cached is not None:
+            return cached
+        init = {
+            f: jnp.asarray(v)
+            for f, v in _init_state(
+                self.config, self._resident, snapshots=False
+            ).items()
+        }
+
+        @jax.jit
+        def apply(state, perm, reset):
+            out = {}
+            for f, v in state.items():
+                g = jnp.take(v, perm, axis=-1)
+                out[f] = jnp.where(reset, init[f], g)
+            return out
+
+        self._barrier_cache = apply
+        return apply
+
+    def _run_scheduled(self, max_cycles: int) -> "PallasEngine":
+        from hpa2_tpu.ops.schedule import LaneScheduler
+
+        cfg = self.config
+        r, w, n = self._resident, self._window, cfg.num_procs
+        sched = LaneScheduler(
+            self._nseg, resident=r, block=self.block,
+            groups=self._sched_groups,
+            threshold=self.schedule.threshold,
+        )
+        runner = self._interval_runner(max_cycles)
+        fields = list(self.state.keys())
+        shapes = state_shapes(cfg, snapshots=False)
+        store = {
+            f: np.zeros(tuple(shapes[f]) + (self.b,), np.int32)
+            for f in fields
+        }
+        state = {
+            f: self._sched_put(jnp.asarray(v))
+            for f, v in _init_state(cfg, r, snapshots=False).items()
+        }
+        tr_np, tl_np = self._tr_np, self._tr_len_np
+        arange_w = np.arange(w)
+        while not sched.done():
+            live = sched.begin_interval()
+            tr_int = np.zeros((n, w, r), np.int32)
+            tl_int = np.zeros((n, r), np.int32)
+            lanes = np.nonzero(live)[0]
+            if len(lanes):
+                sys_ = sched.lane_sys[lanes]
+                base = sched.lane_seg[lanes] * w
+                idx = np.broadcast_to(
+                    base[None, None, :] + arange_w[None, :, None],
+                    (n, w, len(lanes)),
+                )
+                tr_int[:, :, lanes] = np.take_along_axis(
+                    tr_np[:, :, sys_], idx, axis=1
+                )
+                tl_int[:, lanes] = np.clip(
+                    tl_np[:, sys_] - base[None, :], 0, w
+                )
+            state, status = runner(
+                state,
+                self._sched_put(jnp.asarray(tr_int)),
+                self._sched_put(jnp.asarray(tl_int)),
+            )
+            self._check_status(int(status), max_cycles)
+            plan = sched.end_interval()
+            if plan.finished:
+                lane_idx = jnp.asarray(
+                    np.array([l for l, _ in plan.finished])
+                )
+                cols = {
+                    f: np.asarray(jnp.take(state[f], lane_idx, axis=-1))
+                    for f in fields
+                }
+                for i, (_, s) in enumerate(plan.finished):
+                    for f in fields:
+                        store[f][..., s] = cols[f][..., i]
+            if not plan.trivial:
+                perm = (
+                    plan.perm
+                    if plan.perm is not None
+                    else np.arange(r, dtype=np.int64)
+                )
+                reset = np.zeros(r, bool)
+                for lane, _ in plan.admitted:
+                    reset[lane] = True
+                state = self._barrier_fn()(
+                    state, jnp.asarray(perm), jnp.asarray(reset)
+                )
+                state = {
+                    f: self._sched_put(v) for f, v in state.items()
+                }
+        # reconstruct the full-ensemble planes in system order so every
+        # readback accessor (dumps, counters, stats) works unchanged —
+        # the lane->system permutation is inverted here
+        self.state = {
+            f: self._sched_put(jnp.asarray(store[f])) for f in fields
+        }
+        self.occupancy = sched.stats
+        self._completed = True
+        return self
+
     def lower_run(self, max_cycles: int = 1_000_000):
         """Lower (without executing) the whole-run program — the
         compile-gate entry point: ``lower_run().compile()`` on a TPU
@@ -1893,23 +2069,7 @@ class PallasEngine:
             self.state, self._tr_full, self._tr_len_full
         )
 
-    def run(self, max_cycles: int = 1_000_000) -> "PallasEngine":
-        # the on-device driver resets pc at every window base, so a
-        # run is not resumable: completed runs are a no-op, stalled
-        # runs leave in-flight state that only a rebuild can clear
-        if self._completed:
-            return self
-        if self._poisoned:
-            raise StallError(
-                "engine state is mid-flight after a failed run; "
-                "rebuild the engine to retry"
-            )
-        runner = self._runner(max_cycles)
-        state, status = runner(
-            self.state, self._tr_full, self._tr_len_full
-        )
-        self.state = state
-        status = int(status)  # the run's single host sync
+    def _check_status(self, status: int, max_cycles: int) -> None:
         if status:
             self._poisoned = True
         if status & 2:
@@ -1923,6 +2083,26 @@ class PallasEngine:
                 "whole run (livelock? use Semantics.robust(); raise "
                 "max_cycles for long windowed workloads)"
             )
+
+    def run(self, max_cycles: int = 1_000_000) -> "PallasEngine":
+        # the on-device driver resets pc at every window base, so a
+        # run is not resumable: completed runs are a no-op, stalled
+        # runs leave in-flight state that only a rebuild can clear
+        if self._completed:
+            return self
+        if self._poisoned:
+            raise StallError(
+                "engine state is mid-flight after a failed run; "
+                "rebuild the engine to retry"
+            )
+        if self.schedule is not None:
+            return self._run_scheduled(max_cycles)
+        runner = self._runner(max_cycles)
+        state, status = runner(
+            self.state, self._tr_full, self._tr_len_full
+        )
+        self.state = state
+        self._check_status(int(status), max_cycles)  # single host sync
         self._completed = True
         return self
 

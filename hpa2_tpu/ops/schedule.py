@@ -1,0 +1,317 @@
+"""Occupancy scheduling for the ensemble engines.
+
+Lockstep ensembles pay the per-block cycle cost until the *slowest*
+lane in the block drains: on heterogeneous workloads (zipf trace
+lengths, divergent quiescence times) most vector lanes are dead for
+most of the run while wall-clock is unchanged.  The run programs
+already force every lane to quiescence at trace-window segment
+boundaries (``_build_run`` / ``_build_stream_run``), which makes the
+segment barrier a legal reschedule point: any lane may carry any
+system's state into the next window, because systems are independent
+along the lane axis and the pc restarts from the window base.
+
+This module is the *policy*: a deterministic host-side lane scheduler
+that, at each barrier,
+
+1. **harvests** lanes whose system has run out of segments,
+2. **backfills** freed lanes from a per-group admission queue of
+   not-yet-resident systems (ensembles larger than the device-resident
+   batch stream through continuously), and
+3. **compacts** — once the queue is dry and a block's occupancy falls
+   below ``Schedule.threshold`` — by stably packing live lanes into
+   dense blocks so whole blocks go quiescent and skip.
+
+The same policy object is replayed, with no simulator attached, by the
+static occupancy model (``hpa2_tpu/analysis/occupancy.py``) — so the
+model's predicted block-segment count and the engines' measured
+counters agree *exactly*, and the tier-1 pinning assertions are not a
+10%-band fit but an equality.
+
+Groups exist for ``data_shards=``: each shard is one scheduling group
+with its own queue, and lane moves never cross a group boundary — the
+permutation is block-diagonal, preserving the zero-collective cycle
+body of the sharded run program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    """The ``schedule=`` knob shared by both ensemble backends.
+
+    ``resident``: device-resident lanes (rows, for the XLA batch
+    engine).  ``None`` keeps the whole ensemble resident; smaller
+    values stream the ensemble through the device via the admission
+    queue.  ``threshold``: compact a scheduling group once every block
+    is backfilled and some live block's occupancy falls below this
+    fraction (1.0 = compact whenever it frees a block).  ``interval``:
+    cycles per barrier for the XLA batch engine (the Pallas engines
+    barrier at trace-window boundaries instead).
+    """
+
+    resident: Optional[int] = None
+    threshold: float = 0.5
+    interval: int = 256
+
+
+@dataclasses.dataclass
+class OccupancyStats:
+    """Counters from a scheduled run (or its static replay)."""
+
+    intervals: int = 0
+    #: blocks with >= 1 live lane, summed over intervals — the unit of
+    #: device work the gate cannot skip
+    block_segments: int = 0
+    #: what unscheduled lockstep would execute for the same workload
+    lockstep_block_segments: int = 0
+    live_lane_intervals: int = 0
+    lane_intervals: int = 0
+    compactions: int = 0
+    admissions: int = 0
+
+    @property
+    def mean_live_fraction(self) -> float:
+        if not self.lane_intervals:
+            return 0.0
+        return self.live_lane_intervals / self.lane_intervals
+
+    @property
+    def speedup(self) -> float:
+        """Lockstep block-segments over scheduled block-segments."""
+        if not self.block_segments:
+            return 0.0
+        return self.lockstep_block_segments / self.block_segments
+
+    def as_dict(self) -> dict:
+        return {
+            "intervals": self.intervals,
+            "block_segments": self.block_segments,
+            "lockstep_block_segments": self.lockstep_block_segments,
+            "mean_live_fraction": round(self.mean_live_fraction, 4),
+            "speedup": round(self.speedup, 3),
+            "compactions": self.compactions,
+            "admissions": self.admissions,
+        }
+
+
+@dataclasses.dataclass
+class BarrierPlan:
+    """What the engine must do to its carried state at one barrier.
+
+    Apply in order: harvest ``finished`` lane columns (pre-permute
+    indices), gather-permute lanes by ``perm`` (None = identity), then
+    reset ``admitted`` lane columns to the init state (post-permute
+    indices; a group never permutes and admits at the same barrier, so
+    the two never interact).
+    """
+
+    finished: List[Tuple[int, int]]   # (lane, system)
+    admitted: List[Tuple[int, int]]   # (lane, system)
+    perm: Optional[np.ndarray]        # [R] gather indices or None
+
+    @property
+    def trivial(self) -> bool:
+        return not self.admitted and self.perm is None
+
+
+def lockstep_block_segments(nseg: np.ndarray, block: int) -> int:
+    """Block-segments an *unscheduled* lockstep run executes: systems
+    sit at their ensemble index, and every block runs until its slowest
+    lane's last segment (blocks whose lanes have all finished skip at
+    the gate for ~free)."""
+    nseg = np.asarray(nseg)
+    total = 0
+    for lo in range(0, len(nseg), block):
+        total += int(nseg[lo:lo + block].max(initial=0))
+    return total
+
+
+class LaneScheduler:
+    """Deterministic lane->system scheduler, replayed identically by
+    the engines (with the simulator in the middle) and by the static
+    occupancy model (without one).
+
+    ``nseg[s]`` is the number of trace-window segments system ``s``
+    needs (>= 1).  ``resident`` lanes are split into ``groups`` equal
+    contiguous lane ranges; systems are partitioned contiguously over
+    groups and never migrate between them.
+    """
+
+    def __init__(
+        self,
+        nseg: np.ndarray,
+        *,
+        resident: Optional[int] = None,
+        block: int = 1,
+        groups: int = 1,
+        threshold: float = 0.5,
+    ):
+        nseg = np.asarray(nseg, dtype=np.int64)
+        if nseg.ndim != 1 or len(nseg) == 0:
+            raise ValueError("nseg must be a non-empty 1-D array")
+        if (nseg < 1).any():
+            raise ValueError("every system needs >= 1 segment")
+        b = len(nseg)
+        r = b if resident is None else int(resident)
+        if not (0 < r <= b):
+            raise ValueError(f"resident={r} outside 1..{b}")
+        if b % groups or r % groups:
+            raise ValueError(
+                f"batch {b} and resident {r} must divide into "
+                f"{groups} groups"
+            )
+        if (r // groups) % block:
+            raise ValueError(
+                f"per-group lanes {r // groups} not divisible by "
+                f"block {block}"
+            )
+        self.nseg = nseg
+        self.b, self.r = b, r
+        self.block, self.groups = block, groups
+        self.threshold = float(threshold)
+        gl, gs = r // groups, b // groups  # lanes/systems per group
+        self._gl = gl
+        self.lane_sys = np.full(r, -1, dtype=np.int64)
+        self.lane_seg = np.zeros(r, dtype=np.int64)
+        self._queues: List[deque] = []
+        for g in range(groups):
+            sys0 = g * gs
+            fill = min(gl, gs)
+            self.lane_sys[g * gl:g * gl + fill] = np.arange(
+                sys0, sys0 + fill
+            )
+            self._queues.append(deque(range(sys0 + fill, sys0 + gs)))
+        self.stats = OccupancyStats(
+            lockstep_block_segments=lockstep_block_segments(nseg, block)
+        )
+        self._in_interval = False
+
+    # -- interval protocol -------------------------------------------
+
+    def done(self) -> bool:
+        return not (self.lane_sys >= 0).any() and not any(
+            self._queues
+        )
+
+    def live(self) -> np.ndarray:
+        return self.lane_sys >= 0
+
+    def begin_interval(self) -> np.ndarray:
+        """Account one interval's device work; returns the live mask
+        (every live lane runs exactly one trace-window segment)."""
+        if self._in_interval:
+            raise RuntimeError("begin_interval called twice")
+        self._in_interval = True
+        live = self.live()
+        st = self.stats
+        st.intervals += 1
+        st.live_lane_intervals += int(live.sum())
+        st.lane_intervals += self.r
+        blk = live.reshape(-1, self.block)
+        st.block_segments += int(blk.any(axis=1).sum())
+        return live
+
+    def end_interval(self) -> BarrierPlan:
+        """Advance every live lane one segment and plan the barrier:
+        harvest finished systems, backfill from the queues, compact
+        under-occupied groups once their queue is dry."""
+        if not self._in_interval:
+            raise RuntimeError("end_interval before begin_interval")
+        self._in_interval = False
+        live = self.live()
+        self.lane_seg[live] += 1
+        finished: List[Tuple[int, int]] = []
+        for lane in np.nonzero(live)[0]:
+            s = self.lane_sys[lane]
+            if self.lane_seg[lane] >= self.nseg[s]:
+                finished.append((int(lane), int(s)))
+                self.lane_sys[lane] = -1
+                self.lane_seg[lane] = 0
+
+        admitted: List[Tuple[int, int]] = []
+        perm = None
+        gl = self._gl
+        for g in range(self.groups):
+            lo, hi = g * gl, (g + 1) * gl
+            q = self._queues[g]
+            for lane in range(lo, hi):
+                if not q:
+                    break
+                if self.lane_sys[lane] < 0:
+                    s = q.popleft()
+                    self.lane_sys[lane] = s
+                    self.lane_seg[lane] = 0
+                    admitted.append((lane, s))
+            if q:
+                continue  # group is full again; nothing to compact
+            gperm = self._plan_compaction(lo, hi)
+            if gperm is not None:
+                if perm is None:
+                    perm = np.arange(self.r, dtype=np.int64)
+                perm[lo:hi] = gperm
+        self.stats.admissions += len(admitted)
+        return BarrierPlan(finished=finished, admitted=admitted, perm=perm)
+
+    def _plan_compaction(self, lo: int, hi: int) -> Optional[np.ndarray]:
+        """Stable live-lane packing for one group, or None if the
+        occupancy threshold / block-count test says it isn't worth a
+        gather.  Updates lane_sys/lane_seg to the packed layout."""
+        sys_g = self.lane_sys[lo:hi]
+        seg_g = self.lane_seg[lo:hi]
+        live_idx = np.nonzero(sys_g >= 0)[0]
+        n_live = len(live_idx)
+        if not n_live:
+            return None
+        per_block = (sys_g >= 0).reshape(-1, self.block).sum(axis=1)
+        live_blocks = int((per_block > 0).sum())
+        needed = -(-n_live // self.block)
+        min_frac = per_block[per_block > 0].min() / self.block
+        if needed >= live_blocks or min_frac >= self.threshold:
+            return None
+        gperm = np.arange(hi - lo, dtype=np.int64)
+        gperm[:n_live] = live_idx
+        new_sys = np.full(hi - lo, -1, dtype=np.int64)
+        new_seg = np.zeros(hi - lo, dtype=np.int64)
+        new_sys[:n_live] = sys_g[live_idx]
+        new_seg[:n_live] = seg_g[live_idx]
+        self.lane_sys[lo:hi] = new_sys
+        self.lane_seg[lo:hi] = new_seg
+        self.stats.compactions += 1
+        return gperm + lo
+
+
+def simulate(
+    nseg: np.ndarray,
+    *,
+    resident: Optional[int] = None,
+    block: int = 1,
+    groups: int = 1,
+    threshold: float = 0.5,
+) -> OccupancyStats:
+    """The static occupancy model: replay the scheduling policy from a
+    per-system segment-count vector alone.  Because the engines drive
+    the *same* ``LaneScheduler``, the returned ``block_segments``
+    equals a real scheduled run's counter exactly."""
+    sched = LaneScheduler(
+        nseg, resident=resident, block=block, groups=groups,
+        threshold=threshold,
+    )
+    while not sched.done():
+        sched.begin_interval()
+        sched.end_interval()
+    return sched.stats
+
+
+def segments_needed(tr_len: np.ndarray, window: int) -> np.ndarray:
+    """Per-system segment counts from a ``[N, B]`` (or ``[B, N]``-
+    transposed caller-side) per-node trace-length plane: a system needs
+    ``ceil(longest node trace / window)`` segments, minimum one."""
+    longest = np.asarray(tr_len).max(axis=0)
+    return np.maximum(1, -(-longest // int(window)))

@@ -457,14 +457,30 @@ class DataShardedPallasEngine(PallasEngine):
         shard_b = b // shards
         # the per-shard grid tiles shard_b lanes, so the block must
         # divide the SHARD lane count (any divisor of it divides b,
-        # so the base class keeps the choice)
-        block = choose_block(shard_b, block)
+        # so the base class keeps the choice).  Under the occupancy
+        # scheduler the device carries `resident` lanes instead, split
+        # the same way.
+        sched = kwargs.get("schedule")
+        if sched is not None:
+            resident = sched.resident or b
+            if resident % shards:
+                raise ValueError(
+                    f"schedule.resident={resident} not divisible by "
+                    f"data_shards={shards}"
+                )
+            block = choose_block(resident // shards, block)
+        else:
+            block = choose_block(shard_b, block)
         super().__init__(
             config, tr_op, tr_addr, tr_val, tr_len, block=block, **kwargs
         )
         self.mesh = mesh
         self.data_shards = shards
         self._shard_b = shard_b
+        # shard-local scheduling: each shard is one group with its own
+        # admission queue; compaction permutations are block-diagonal
+        # over groups, so lanes never migrate across devices
+        self._sched_groups = shards
 
         def put(x):
             return jax.device_put(
@@ -481,4 +497,18 @@ class DataShardedPallasEngine(PallasEngine):
             self.config, self._shard_b, self.block, self.cycles_per_call,
             self._interpret, self._snapshots, self._window, self._n_seg,
             max_calls, self.mesh, self._stream, self._ablate, self._gate,
+        )
+
+    def _interval_runner(self, max_cycles: int):
+        max_calls = max(1, -(-max_cycles // self.cycles_per_call))
+        return build_data_sharded_pallas_run(
+            self.config, self._resident // self.data_shards, self.block,
+            self.cycles_per_call, self._interpret, False, self._window,
+            1, max_calls, self.mesh, self._stream, self._ablate,
+            self._gate,
+        )
+
+    def _sched_put(self, x):
+        return jax.device_put(
+            x, NamedSharding(self.mesh, _lane_spec(x.ndim))
         )

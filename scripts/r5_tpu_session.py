@@ -25,7 +25,12 @@ Steps, in value order:
  10. sweep512_dp   — the shipped bench shape with the ensemble split
                      across every local chip (DataShardedPallasEngine;
                      shards=0 means "all devices")
- 11. multichip     — the data_shards scaling ladder + bit-exactness
+ 11. occupancy512  — occupancy scheduler (schedule=) on the shipped
+                     shape over a heterogeneous zipf workload (8x
+                     max/median trace-length spread): scheduled vs
+                     unscheduled wall-clock + block-segment counters,
+                     with a per-system scalars bit-exactness check
+ 12. multichip     — the data_shards scaling ladder + bit-exactness
                      check (scripts/scale_runs.py multichip), which
                      writes MULTICHIP_r06.json with indicative:true
                      pod-slice numbers
@@ -164,6 +169,53 @@ def measure_child(params) -> int:
     return 0
 
 
+def measure_occupancy_child(params) -> int:
+    """--measure-occupancy mode: heterogeneous (zipf) ensemble, one
+    unscheduled and one scheduled run, wall-clock + occupancy
+    counters, one JSON line out.  Nonzero exit iff the scheduled
+    run's per-system scalars plane (cycle/instr/hit/miss counters,
+    schedule-invariant by design) differs from the unscheduled one."""
+    import numpy as np
+
+    from hpa2_tpu.config import Semantics, SystemConfig
+    from hpa2_tpu.ops.pallas_engine import PallasEngine
+    from hpa2_tpu.ops.schedule import Schedule
+    from hpa2_tpu.utils.trace import gen_heterogeneous_random_arrays
+
+    batch, instrs, block, k, cap, window, gate, spread = params[:8]
+    config = SystemConfig(num_procs=8, msg_buffer_size=cap,
+                          semantics=Semantics().robust())
+    arrays = gen_heterogeneous_random_arrays(
+        config, batch, instrs, dist="zipf", spread=float(spread),
+        seed=0)
+    kw = dict(block=block, cycles_per_call=k, snapshots=False,
+              trace_window=window, gate=bool(gate))
+
+    def timed(schedule):
+        eng = PallasEngine(config, *arrays, schedule=schedule, **kw)
+        t0 = time.perf_counter()
+        eng.run(max_cycles=5_000_000)
+        return eng, time.perf_counter() - t0
+
+    # warm BOTH programs: the unscheduled multi-segment run and the
+    # scheduler's n_seg=1 interval program are different lru-cache
+    # entries, so each timed run needs its own compile out of the way
+    timed(None)
+    timed(Schedule())
+    ref, ref_dt = timed(None)
+    eng, dt = timed(Schedule())
+    exact = bool(np.array_equal(np.asarray(eng.state["scalars"]),
+                                np.asarray(ref.state["scalars"])))
+    print(json.dumps({
+        "batch": batch, "instrs": instrs, "block": block, "k": k,
+        "cap": cap, "window": window, "gate": gate, "spread": spread,
+        "unscheduled_s": round(ref_dt, 3), "scheduled_s": round(dt, 3),
+        "wall_speedup": round(ref_dt / dt, 2) if dt else None,
+        "occupancy": eng.occupancy.as_dict(), "bit_exact": exact,
+    }))
+    return 0 if exact else 1
+
+
 def measure(step, batch, instrs, block, k, cap, window, gate,
             timeout_s=900, shards=1):
     params = [batch, instrs, block, k, cap, window, gate]
@@ -243,6 +295,10 @@ _PROBE_CODE = (
 def main() -> int:
     if sys.argv[1:2] == ["--measure"]:
         return measure_child([int(x) for x in sys.argv[2:10]])
+    if sys.argv[1:2] == ["--measure-occupancy"]:
+        return measure_occupancy_child(
+            [int(x) for x in sys.argv[2:10]]
+        )
     session_start = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     skip = set()
     for i, a in enumerate(sys.argv):
@@ -348,6 +404,17 @@ def main() -> int:
         # this row's ops_per_sec over sweep512's
         note(measure("sweep512_dp", 32768, 128, 512, 128, 16, 32, 1,
                      shards=0))
+    if "occupancy512" not in skip and gate("occupancy512"):
+        # the occupancy scheduler on the shipped shape: zipf trace
+        # lengths (8x max/median spread), scheduled vs unscheduled
+        # wall-clock — the hardware read on what the block-segment
+        # counters (tier-1-asserted on CPU) buy in real seconds
+        note(run_py(
+            "occupancy512",
+            [os.path.abspath(__file__), "--measure-occupancy",
+             "32768", "128", "512", "128", "16", "32", "1", "8"],
+            timeout_s=1800, argv=True))
+
     if "multichip" not in skip and gate("multichip"):
         # full data_shards ladder + bit-exactness gate; rewrites
         # MULTICHIP_r06.json with indicative:true pod-slice numbers

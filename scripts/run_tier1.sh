@@ -15,6 +15,14 @@ case "${XLA_FLAGS:-}" in
   *xla_force_host_platform_device_count*) ;;
   *) export XLA_FLAGS="--xla_force_host_platform_device_count=8${XLA_FLAGS:+ $XLA_FLAGS}" ;;
 esac
+# Occupancy-scheduler model smoke (pure numpy, ~1s): nonzero rc means
+# the barrier policy predicts doing MORE work than the lockstep bound
+# (a policy bug) — fail fast, before spending the pytest budget.
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m hpa2_tpu.analysis occupancy > /dev/null; then
+  echo "TIER1: analysis occupancy smoke failed" >&2
+  exit 1
+fi
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log

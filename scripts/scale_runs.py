@@ -75,20 +75,38 @@ def _build_pallas(config, arrays, data_shards, **kw):
     return PallasEngine(config, *arrays, **kw)
 
 
-def config5(batch=1024, instrs_per_core=10_000, data_shards=1):
+def config5(batch=1024, instrs_per_core=10_000, data_shards=1,
+            dist=None, spread=8.0, schedule=False):
+    """``--trace-len-dist zipf`` swaps the uniform workload for
+    heterogeneous per-system trace lengths and ``--schedule`` turns on
+    the occupancy scheduler (ops/schedule.py) — together the config-5
+    demo of live-lane compaction at scale, reporting the measured
+    occupancy counters alongside the throughput."""
     import numpy as np
 
     from hpa2_tpu.config import Semantics, SystemConfig
     from hpa2_tpu.ops.pallas_engine import _SC_CYCLE
-    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+    from hpa2_tpu.utils.trace import (
+        gen_heterogeneous_random_arrays,
+        gen_uniform_random_arrays,
+    )
 
     config = SystemConfig(
         num_procs=8, msg_buffer_size=16, max_instr_num=0,
         semantics=Semantics().robust(),
     )
-    arrays = gen_uniform_random_arrays(config, batch, instrs_per_core)
+    if dist:
+        arrays = gen_heterogeneous_random_arrays(
+            config, batch, instrs_per_core, dist=dist, spread=spread)
+    else:
+        arrays = gen_uniform_random_arrays(config, batch,
+                                           instrs_per_core)
     kw = dict(block=512, cycles_per_call=128, snapshots=False,
               trace_window=32)
+    if schedule:
+        from hpa2_tpu.ops.schedule import Schedule
+
+        kw["schedule"] = Schedule()
 
     def build():
         return _build_pallas(config, arrays, data_shards, **kw)
@@ -108,6 +126,10 @@ def config5(batch=1024, instrs_per_core=10_000, data_shards=1):
     }
     if data_shards != 1:
         rec["data_shards"] = data_shards
+    if dist:
+        rec["trace_len_dist"] = {"dist": dist, "spread": spread}
+    if schedule:
+        rec["occupancy"] = eng.occupancy.as_dict()
     print(json.dumps(rec), flush=True)
 
 

@@ -1,0 +1,327 @@
+"""Occupancy scheduler (``schedule=`` on both ensemble engines).
+
+Three properties pin the design (PERF.md "Occupancy scheduler"):
+
+1. **Bit-exactness** — systems are independent along the lane/row
+   axis, so compacting, backfilling, and streaming them through the
+   device must leave every per-system final dump (and, on the Pallas
+   engine, the whole per-system scalars plane) bit-identical to the
+   unscheduled run — including under ``data_shards=`` and fault
+   injection.
+2. **The win** — on a heterogeneous (zipf) workload the scheduled run
+   executes >= 2x fewer block-segments than the unscheduled lockstep
+   bound, measured from real run counters.
+3. **Zero hot-loop cost** — one scheduling interval IS the unscheduled
+   run program built at ``n_seg=1``: the lru-cached builder returns
+   the identical function object, so the cycle loop provably gains no
+   gather/scatter/DMA (an identity is the strongest jaxpr guard).
+   Compaction ops live only in the separate jitted barrier transform.
+
+The static model (``analysis occupancy``) replays the same policy, so
+its predicted counters must equal the measured ones exactly.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from hpa2_tpu.config import FaultModel, Semantics, SystemConfig
+from hpa2_tpu.ops.pallas_engine import PallasEngine, _build_stream_run
+from hpa2_tpu.ops.schedule import (
+    LaneScheduler,
+    Schedule,
+    lockstep_block_segments,
+    segments_needed,
+    simulate,
+)
+from hpa2_tpu.utils.trace import (
+    gen_heterogeneous_random_arrays,
+    gen_uniform_random,
+    heterogeneous_lengths,
+)
+
+ROBUST = Semantics().robust()
+
+# interpret-mode runs are slow: one shared small geometry for the
+# exactness tests, one larger zipf geometry for the >= 2x acceptance
+# test (5 blocks of 8 lanes; max/median = 8x at this seed)
+_KW = dict(block=4, cycles_per_call=32, snapshots=False, trace_window=8,
+           gate=True)
+_ZIPF_KW = dict(block=8, cycles_per_call=32, snapshots=False,
+                trace_window=8, gate=True)
+
+
+def _require_devices(n):
+    import jax
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return SystemConfig(num_procs=4, semantics=ROBUST)
+
+
+@pytest.fixture(scope="module")
+def small_zipf(cfg):
+    """(arrays, unscheduled reference engine) at the shared small
+    geometry: batch 24, zipf lengths 8..32."""
+    arrays = gen_heterogeneous_random_arrays(
+        cfg, 24, 32, dist="zipf", spread=4.0, seed=1
+    )
+    ref = PallasEngine(cfg, *arrays, **_KW).run()
+    return arrays, ref
+
+
+def _dumps_match(eng, ref, batch):
+    return all(
+        eng.system_final_dumps(s) == ref.system_final_dumps(s)
+        for s in range(batch)
+    )
+
+
+# -- the static model / policy ---------------------------------------------
+
+
+def test_model_never_beats_lockstep_and_conserves_work():
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        b = int(rng.integers(4, 40))
+        block = int(rng.choice([1, 2, 4]))
+        b -= b % (2 * block)
+        if b < 2 * block:
+            continue
+        nseg = rng.integers(1, 9, size=b)
+        r = b // 2 * 2
+        st = simulate(nseg, resident=r, block=block, groups=1,
+                      threshold=0.5)
+        # every system runs every one of its segments exactly once
+        assert st.live_lane_intervals == int(nseg.sum())
+        assert st.lockstep_block_segments == lockstep_block_segments(
+            nseg, block
+        )
+        assert st.block_segments <= st.lockstep_block_segments
+        assert st.admissions == b - r
+
+
+def test_segments_needed_from_length_plane():
+    tr_len = np.array([[3, 8, 0], [9, 1, 0]])  # [N=2, B=3]
+    assert segments_needed(tr_len, 4).tolist() == [3, 2, 1]
+
+
+def test_scheduler_rejects_bad_shapes():
+    nseg = np.ones(8, dtype=np.int64)
+    with pytest.raises(ValueError):
+        LaneScheduler(nseg, resident=6, block=4)  # 6 % 4 != 0
+    with pytest.raises(ValueError):
+        LaneScheduler(nseg, resident=8, block=4, groups=3)
+    with pytest.raises(ValueError):
+        PallasEngine(
+            SystemConfig(num_procs=4, semantics=ROBUST),
+            *gen_heterogeneous_random_arrays(
+                SystemConfig(num_procs=4, semantics=ROBUST), 8, 16
+            ),
+            schedule=Schedule(), snapshots=True,
+        )
+
+
+# -- zero hot-loop cost (jaxpr guard) --------------------------------------
+
+
+def test_interval_is_the_unscheduled_program(cfg, small_zipf):
+    """The scheduler's per-interval program must BE the unscheduled
+    n_seg=1 run program — the lru-cached builder returns the identical
+    object, so scheduling adds zero ops (no gather/scatter/DMA) to the
+    while-to-quiescence cycle loop.  Lane permutation and admission
+    resets live only in the separate jitted barrier transform."""
+    arrays, _ = small_zipf
+    eng = PallasEngine(cfg, *arrays, schedule=Schedule(), **_KW)
+    max_cycles = 10_000
+    max_calls = max(1, -(-max_cycles // eng.cycles_per_call))
+    assert eng._interval_runner(max_cycles) is _build_stream_run(
+        cfg, eng._resident, eng.block, eng.cycles_per_call,
+        eng._interpret, False, eng._window, 1, max_calls, frozenset(),
+        True,
+    )
+    # the barrier transform is a different function entirely
+    assert eng._barrier_fn() is not eng._interval_runner(max_cycles)
+
+
+# -- bit-exactness + the >= 2x win -----------------------------------------
+
+
+def test_zipf_scheduled_2x_fewer_block_segments_bit_exact(cfg):
+    """Acceptance geometry: 40 systems in 5 blocks of 8, zipf trace
+    lengths with an 8x max/median spread.  The scheduled run must do
+    >= 2x fewer block-segments than the unscheduled lockstep bound
+    (real run counters, CPU interpret path) while every per-system
+    dump AND the whole per-system scalars plane stay bit-identical.
+    The static model must predict the measured counters exactly."""
+    arrays = gen_heterogeneous_random_arrays(
+        cfg, 40, 64, dist="zipf", spread=8.0, seed=2
+    )
+    lens = heterogeneous_lengths(40, 64, dist="zipf", spread=8.0, seed=2)
+    med = float(np.median(lens))
+    assert lens.max() / med >= 4.0  # the workload really is skewed
+
+    ref = PallasEngine(cfg, *arrays, **_ZIPF_KW).run()
+    eng = PallasEngine(cfg, *arrays, schedule=Schedule(), **_ZIPF_KW)
+    assert eng.b // eng.block >= 4  # >= 4 blocks, per the bar
+    eng.run()
+
+    occ = eng.occupancy
+    assert occ.block_segments * 2 <= occ.lockstep_block_segments
+    assert occ.compactions > 0
+
+    assert _dumps_match(eng, ref, 40)
+    assert np.array_equal(
+        np.asarray(eng.state["scalars"]), np.asarray(ref.state["scalars"])
+    )
+
+    # exact-replay model pinning (trivially satisfies the 10% band)
+    model = simulate(
+        segments_needed(eng._tr_len_np, eng._window),
+        resident=eng._resident, block=eng.block, groups=1,
+        threshold=eng.schedule.threshold,
+    )
+    assert model.block_segments == occ.block_segments
+    assert model.lockstep_block_segments == occ.lockstep_block_segments
+    assert model.compactions == occ.compactions
+    assert model.admissions == occ.admissions
+
+    from hpa2_tpu.analysis.occupancy import predicted_stats
+
+    pred = predicted_stats(lens, _ZIPF_KW["trace_window"], eng.block)
+    assert pred.block_segments == occ.block_segments
+
+
+def test_streaming_resident_bit_exact(cfg, small_zipf):
+    """resident < batch: the ensemble streams through the device via
+    the admission queue; dumps stay bit-exact."""
+    arrays, ref = small_zipf
+    eng = PallasEngine(
+        cfg, *arrays, schedule=Schedule(resident=8), **_KW
+    ).run()
+    assert eng.occupancy.admissions == 24 - 8
+    assert _dumps_match(eng, ref, 24)
+
+
+@pytest.mark.virtual_mesh
+def test_scheduled_data_sharded_bit_exact(cfg, small_zipf):
+    """schedule= composes with data_shards=: shard-local queues and
+    block-diagonal permutations (no cross-device lane moves), still
+    bit-exact per system."""
+    _require_devices(2)
+    from hpa2_tpu.parallel.sharding import DataShardedPallasEngine
+
+    arrays, ref = small_zipf
+    eng = DataShardedPallasEngine(
+        cfg, *arrays, data_shards=2, schedule=Schedule(), **_KW
+    ).run()
+    assert eng.occupancy.block_segments > 0
+    assert _dumps_match(eng, ref, 24)
+
+
+def test_batchjax_scheduled_with_faults_bit_exact(cfg):
+    """XLA ensemble: chunk-barrier scheduling with streaming admission
+    is bit-exact for dumps and fault counters even with an active
+    fault model — each system's rng_key is seeded independently of its
+    batch row, so fault streams survive row reassignment."""
+    fcfg = dataclasses.replace(
+        cfg, fault=FaultModel(drop=0.2, duplicate=0.1, reorder=0.2,
+                              seed=7)
+    )
+    lens = heterogeneous_lengths(12, 24, dist="zipf", spread=4.0, seed=3)
+    batch = [
+        gen_uniform_random(fcfg, int(n), seed=100 + s)
+        for s, n in enumerate(lens)
+    ]
+    from hpa2_tpu.ops.engine import BatchJaxEngine
+
+    ref = BatchJaxEngine(fcfg, batch).run()
+    eng = BatchJaxEngine(
+        fcfg, batch, schedule=Schedule(resident=4, interval=64)
+    ).run()
+    assert eng.occupancy.admissions == 12 - 4
+    assert _dumps_match(eng, ref, 12)
+    assert eng.stats()["fault_retransmissions"] == (
+        ref.stats()["fault_retransmissions"]
+    )
+    assert eng.stats()["fault_retransmissions"] > 0
+
+
+@pytest.mark.virtual_mesh
+def test_batchjax_scheduled_data_sharded_bit_exact(cfg):
+    _require_devices(2)
+    lens = heterogeneous_lengths(12, 24, dist="zipf", spread=4.0, seed=3)
+    batch = [
+        gen_uniform_random(cfg, int(n), seed=100 + s)
+        for s, n in enumerate(lens)
+    ]
+    from hpa2_tpu.ops.engine import BatchJaxEngine
+
+    ref = BatchJaxEngine(cfg, batch).run()
+    eng = BatchJaxEngine(
+        cfg, batch, data_shards=2,
+        schedule=Schedule(resident=4, interval=64),
+    ).run()
+    assert _dumps_match(eng, ref, 12)
+
+
+# -- lane-permutation invariance (the property scheduling relies on) -------
+
+
+def test_lane_permutation_invariance_both_engines(cfg, small_zipf):
+    """Shuffling the ensemble lane order of an UNSCHEDULED run leaves
+    every per-system final dump bit-identical on both ensemble
+    engines — the independence property the scheduler's compaction
+    permutations rest on."""
+    arrays, ref = small_zipf
+    perm = np.random.default_rng(5).permutation(24)
+    shuf = tuple(a[perm] for a in arrays)
+    eng = PallasEngine(cfg, *shuf, **_KW).run()
+    for s in range(24):
+        assert eng.system_final_dumps(s) == ref.system_final_dumps(
+            int(perm[s])
+        )
+
+    from hpa2_tpu.ops.engine import BatchJaxEngine
+
+    lens = heterogeneous_lengths(10, 20, dist="zipf", spread=4.0, seed=4)
+    batch = [
+        gen_uniform_random(cfg, int(n), seed=200 + s)
+        for s, n in enumerate(lens)
+    ]
+    xref = BatchJaxEngine(cfg, batch).run()
+    xperm = np.random.default_rng(6).permutation(10)
+    xeng = BatchJaxEngine(cfg, [batch[i] for i in xperm]).run()
+    for s in range(10):
+        assert xeng.system_final_dumps(s) == xref.system_final_dumps(
+            int(xperm[s])
+        )
+
+
+# -- heterogeneous workload generator --------------------------------------
+
+
+def test_heterogeneous_lengths_properties():
+    for dist in ("uniform", "zipf"):
+        lens = heterogeneous_lengths(64, 96, dist=dist, spread=8.0,
+                                     seed=0)
+        assert lens.shape == (64,)
+        assert lens.min() >= max(1, round(96 / 8.0))
+        assert lens.max() == 96  # one system pinned to the max
+    with pytest.raises(ValueError):
+        heterogeneous_lengths(8, 16, dist="bimodal")
+    with pytest.raises(ValueError):
+        heterogeneous_lengths(8, 16, spread=0.5)
+
+
+def test_occupancy_cli_table():
+    from hpa2_tpu.analysis.occupancy import occupancy_table
+
+    table, rc = occupancy_table(32, 48, 8, 8, spreads=(4.0, 8.0))
+    assert rc == 0
+    assert "lockstep" in table and "zipf" in table
