@@ -90,6 +90,27 @@ def _data_shards() -> int:
         return 1
 
 
+def _packed() -> bool:
+    """Packed-state-plane knob (``--packed``): run the Pallas engines
+    with the uint8/uint16 split planes instead of int32 words."""
+    return os.environ.get("HPA2_BENCH_PACKED", "") == "1"
+
+
+def _schedule_knobs():
+    """Occupancy-scheduler knobs: ``--schedule-resident N`` turns the
+    scheduler on (0 = off), ``--host-barriers`` selects the PR-5
+    one-launch-per-interval loop instead of the fused single-program
+    default.  Returns (resident, fused)."""
+    try:
+        resident = int(
+            os.environ.get("HPA2_BENCH_SCHEDULE_RESIDENT", "0")
+        )
+    except ValueError:
+        resident = 0
+    fused = os.environ.get("HPA2_BENCH_HOST_BARRIERS", "") != "1"
+    return max(0, resident), fused
+
+
 def _trace_len_dist():
     """Heterogeneous-workload knob (``--trace-len-dist``): returns
     (dist, spread) or (None, spread) for the default homogeneous
@@ -143,12 +164,20 @@ def compile_gate_main() -> int:
 
 
 def bench_pallas(config, batch, instrs_per_core, seed=0, data_shards=1,
-                 dist=None, spread=8.0):
+                 dist=None, spread=8.0, packed=False,
+                 schedule_resident=0, fused=True):
     from hpa2_tpu.ops.pallas_engine import PallasEngine
     from hpa2_tpu.utils.trace import (gen_heterogeneous_random_arrays,
                                       gen_uniform_random_arrays)
 
     block, window, k, gate = _tuned_shape()
+    schedule = None
+    if schedule_resident:
+        from hpa2_tpu.ops.schedule import Schedule
+
+        schedule = Schedule(
+            resident=min(schedule_resident, batch), fused=fused
+        )
     occupancy = None
     if dist:
         arrays = gen_heterogeneous_random_arrays(
@@ -175,6 +204,9 @@ def bench_pallas(config, batch, instrs_per_core, seed=0, data_shards=1,
         arrays = gen_uniform_random_arrays(config, batch,
                                            instrs_per_core, seed=seed)
 
+    extra = dict(packed=packed)
+    if schedule is not None:
+        extra["schedule"] = schedule
     if data_shards > 1:
         from hpa2_tpu.parallel.sharding import DataShardedPallasEngine
 
@@ -182,19 +214,27 @@ def bench_pallas(config, batch, instrs_per_core, seed=0, data_shards=1,
             return DataShardedPallasEngine(
                 config, *arrays, data_shards=data_shards, block=block,
                 cycles_per_call=k, snapshots=False,
-                trace_window=window, gate=gate)
+                trace_window=window, gate=gate, **extra)
     else:
 
         def build():
             return PallasEngine(config, *arrays, block=block,
                                 cycles_per_call=k, snapshots=False,
-                                trace_window=window, gate=gate)
+                                trace_window=window, gate=gate, **extra)
 
     build().run()  # compile + warmup
     eng = build()
     t0 = time.perf_counter()
     eng.run()
     dt = time.perf_counter() - t0
+    if schedule is not None:
+        # a scheduled run reports ITS occupancy counters — on the
+        # fused path they flow from the plan/replay model (the host
+        # loop that used to measure them no longer exists), on the
+        # PR-5 path from the loop itself; the work counters are
+        # bit-identical either way, only the launch accounting
+        # (host_barriers/device_programs) differs
+        occupancy = eng.occupancy.as_dict()
     return eng.instructions, dt, occupancy
 
 
@@ -251,6 +291,8 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
         batch = -(-batch // shards) * shards
 
     dist, spread = _trace_len_dist()
+    packed = _packed()
+    resident, fused = _schedule_knobs()
     engine = "pallas"
     err = pallas_error
     ran_ok = False
@@ -259,7 +301,8 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
         try:
             jax_instrs, jax_dt, occupancy = bench_pallas(
                 config, batch, instrs_per_core, data_shards=shards,
-                dist=dist, spread=spread)
+                dist=dist, spread=spread, packed=packed,
+                schedule_resident=resident, fused=fused)
             ran_ok = True
         except Exception as e:  # noqa: BLE001
             err = str(e)[-300:]
@@ -286,10 +329,18 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
         "jax_instrs": jax_instrs,
         "jax_seconds": round(jax_dt, 4),
     }
+    # kernel-layout / scheduler provenance: always recorded so artifact
+    # diffs across rounds show WHICH path produced the number
+    result["packed_planes"] = packed and engine == "pallas"
+    result["fused_schedule"] = bool(
+        resident and fused and engine == "pallas"
+    )
+    if resident and engine == "pallas":
+        result["schedule"] = {"resident": resident, "fused": fused}
     if dist:
         result["trace_len_dist"] = {"dist": dist, "spread": spread}
-        if occupancy is not None:
-            result["occupancy"] = occupancy
+    if occupancy is not None:
+        result["occupancy"] = occupancy
     if shards != 1:
         import jax
 
@@ -546,6 +597,25 @@ def main() -> int:
             print("usage: bench.py [--trace-len-spread RATIO]",
                   file=sys.stderr)
             return 2
+    if "--packed" in sys.argv:
+        # uint8/uint16 packed state planes (ISSUE 6): ~2x the lanes
+        # per VMEM budget; bit-exact vs the int32 layout
+        os.environ["HPA2_BENCH_PACKED"] = "1"
+    if "--schedule-resident" in sys.argv:
+        # occupancy scheduler with this many device-resident lanes;
+        # fused single-program by default, --host-barriers for the
+        # PR-5 one-launch-per-interval loop
+        i = sys.argv.index("--schedule-resident")
+        try:
+            os.environ["HPA2_BENCH_SCHEDULE_RESIDENT"] = str(
+                int(sys.argv[i + 1])
+            )
+        except (IndexError, ValueError):
+            print("usage: bench.py [--schedule-resident N]",
+                  file=sys.stderr)
+            return 2
+    if "--host-barriers" in sys.argv:
+        os.environ["HPA2_BENCH_HOST_BARRIERS"] = "1"
 
     tpu_ok = _probe_tpu()
     result = None

@@ -115,9 +115,11 @@ def cmd_vmem(args: argparse.Namespace) -> int:
     )
     blocks = tuple(int(b) for b in args.blocks.split(","))
     print(budget_table(cfg, blocks, args.window,
-                       snapshots=args.snapshots, gate=args.gate))
+                       snapshots=args.snapshots, gate=args.gate,
+                       packed=args.packed))
     worst = vmem_budget(cfg, max(blocks), args.window,
-                        snapshots=args.snapshots, gate=args.gate)
+                        snapshots=args.snapshots, gate=args.gate,
+                        packed=args.packed)
     return 0 if worst.fits else 1
 
 
@@ -132,6 +134,7 @@ def cmd_occupancy(args: argparse.Namespace) -> int:
         resident=args.resident,
         groups=args.groups,
         seed=args.seed,
+        fused=not args.host_barriers,
     )
     print(table)
     if rc:
@@ -184,6 +187,8 @@ def main(argv=None) -> int:
                     help="mailbox capacity (msg_buffer_size)")
     vp.add_argument("--snapshots", action="store_true")
     vp.add_argument("--gate", action="store_true")
+    vp.add_argument("--packed", action="store_true",
+                    help="model the packed uint8/uint16 state planes")
     op = sub.add_parser("occupancy", help="occupancy scheduler model")
     op.add_argument("--batch", type=int, default=64)
     op.add_argument("--instrs", type=int, default=96,
@@ -201,6 +206,9 @@ def main(argv=None) -> int:
     op.add_argument("--groups", type=int, default=1,
                     help="scheduling groups (data shards)")
     op.add_argument("--seed", type=int, default=0)
+    op.add_argument("--host-barriers", action="store_true",
+                    help="model the PR-5 one-launch-per-interval host "
+                         "loop instead of the fused single-program run")
     args = p.parse_args(argv)
     args.sem = [s.strip() for s in args.sem.split(",") if s.strip()]
     for s in args.sem:

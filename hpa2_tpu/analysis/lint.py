@@ -1,6 +1,6 @@
 """AST lint for JAX pitfalls and dead spec handlers.
 
-Four rules, all tuned to be zero-finding on clean engine code:
+Five rules, all tuned to be zero-finding on clean engine code:
 
 * **traced-branch** — a Python ``if``/``while``/``assert``/ternary in a
   JAX op module whose test reads a value derived from a ``SimState``
@@ -21,6 +21,14 @@ Four rules, all tuned to be zero-finding on clean engine code:
   ``jax_enable_x64`` off these silently narrow to 32 bits, so the code
   computes in a different width than it names.  Host-side ``np.int64``
   is fine (and used deliberately for trace packing).
+* **dtype-widening** — arithmetic (or an ``astype``) on a packed
+  uint8/uint16 state plane (``cvalw``/``cmetaw``/``dmemw``/``dmetaw``
+  and their ``snap_`` twins) outside the sanctioned ``_widen*`` /
+  ``_narrow*`` helpers.  JAX promotes the narrow operand silently, so
+  a stray ``cmetaw + 1`` computes in int32 and re-materialises the
+  plane at 4 bytes/row — exactly the VMEM rent the packed layout pays
+  down.  All promotion must funnel through the audited helpers so the
+  cycle body stays narrow.
 * **dead-handler** — ``spec_engine.py``'s ``_on_*`` methods must all be
   registered in the ``_DISPATCH`` map, every registration must resolve
   to a real method, and every ``MsgType`` must be dispatched.  An
@@ -262,6 +270,111 @@ class _DtypeDriftVisitor(ast.NodeVisitor):
 
 
 # ---------------------------------------------------------------------------
+# dtype-widening (packed state planes)
+# ---------------------------------------------------------------------------
+
+#: the packed uint8/uint16 state planes (ops/pallas_engine.py
+#: ``_PACKED_CACHE`` + ``_PACKED_DIR``), plus their snapshot twins
+PACKED_PLANES = frozenset(
+    p for base in ("cvalw", "cmetaw", "dmemw", "dmetaw")
+    for p in (base, f"snap_{base}")
+)
+#: the only functions allowed to do arithmetic on packed planes: the
+#: in-kernel widen/narrow pairs and the host-side numpy converters
+SANCTIONED_WIDENERS = frozenset({
+    "_widen", "_narrow",
+    "_widen_cache", "_narrow_cache", "_widen_dir", "_narrow_dir",
+    "_split_word_planes_np", "_join_word_planes_np",
+})
+
+
+class _DtypeWideningVisitor(ast.NodeVisitor):
+    """Flags arithmetic on packed-plane reads outside the sanctioned
+    widen/narrow helpers.  A packed-plane read is a Name spelled like
+    the plane or a ``Constant``-string subscript of one (``s["cvalw"]``);
+    structural ops (gather/where/stack/indexing) pass through
+    untouched, so only BinOp/Compare/UnaryOp — and a stray
+    ``.astype`` — count as promotion sites."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[LintFinding] = []
+
+    def visit_FunctionDef(self, fn: ast.FunctionDef) -> None:
+        if fn.name in SANCTIONED_WIDENERS:
+            return  # the audited promotion sites
+        self.generic_visit(fn)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @classmethod
+    def _packed_read(cls, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name) and node.id in PACKED_PLANES:
+            return node.id
+        if isinstance(node, ast.Subscript):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and sl.value in PACKED_PLANES:
+                return sl.value
+        return None
+
+    @classmethod
+    def _find_packed_read(cls, expr: ast.AST) -> Optional[str]:
+        hit = cls._packed_read(expr)
+        if hit:
+            return hit
+        # a call boundary hands the plane to a callee (usually a
+        # sanctioned helper) — the callee body is scanned on its own,
+        # so the argument read itself is not a promotion
+        if isinstance(expr, ast.Call):
+            return None
+        for child in ast.iter_child_nodes(expr):
+            hit = cls._find_packed_read(child)
+            if hit:
+                return hit
+        return None
+
+    def _flag(self, node: ast.AST, plane: str, what: str) -> None:
+        self.findings.append(LintFinding(
+            "dtype-widening", self.path, node.lineno,
+            f"{what} on packed plane {plane!r} outside the sanctioned "
+            f"_widen*/_narrow* helpers — the uint8/uint16 plane "
+            f"silently promotes to int32 in the kernel body"))
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        for side in (node.left, node.right):
+            hit = self._find_packed_read(side)
+            if hit:
+                self._flag(node, hit, "arithmetic")
+                break
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        for side in [node.left] + list(node.comparators):
+            hit = self._find_packed_read(side)
+            if hit:
+                self._flag(node, hit, "comparison")
+                break
+        self.generic_visit(node)
+
+    def visit_UnaryOp(self, node: ast.UnaryOp) -> None:
+        if isinstance(node.op, (ast.Invert, ast.USub)):
+            hit = self._find_packed_read(node.operand)
+            if hit:
+                self._flag(node, hit, "arithmetic")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "astype":
+            hit = self._find_packed_read(f.value) or self._packed_read(
+                f.value
+            )
+            if hit:
+                self._flag(node, hit, "astype")
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
 # dead-handler (spec_engine dispatch registration)
 # ---------------------------------------------------------------------------
 
@@ -353,6 +466,9 @@ def lint_file(repo_root: str, rel: str) -> List[LintFinding]:
         dd = _DtypeDriftVisitor(rel)
         dd.visit(tree)
         findings.extend(dd.findings)
+        dw = _DtypeWideningVisitor(rel)
+        dw.visit(tree)
+        findings.extend(dw.findings)
     if rel.endswith(os.path.join("models", "spec_engine.py")):
         findings.extend(_lint_dispatch(rel, tree))
     return findings

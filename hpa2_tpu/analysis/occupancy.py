@@ -38,15 +38,19 @@ def predicted_stats(
     resident: Optional[int] = None,
     groups: int = 1,
     threshold: float = 0.5,
+    fused: bool = True,
 ) -> OccupancyStats:
     """Model a scheduled run over per-system trace lengths: convert
-    lengths to segment counts and replay the barrier policy."""
+    lengths to segment counts and replay the barrier policy.  ``fused``
+    selects the launch accounting: the fused path costs one device
+    program and zero host barriers per run; the PR-5 host loop pays
+    one of each per scheduling interval."""
     nseg = np.maximum(
         1, -(-np.asarray(lengths, dtype=np.int64) // int(window))
     )
     return simulate(
         nseg, resident=resident, block=block, groups=groups,
-        threshold=threshold,
+        threshold=threshold, fused=fused,
     )
 
 
@@ -62,20 +66,25 @@ def occupancy_table(
     resident: Optional[int] = None,
     groups: int = 1,
     seed: int = 0,
+    fused: bool = True,
 ) -> Tuple[str, int]:
     """The ``analysis occupancy`` report: scheduled vs lockstep
-    block-segments per workload shape.  Returns (table, rc) — rc is
-    nonzero if the model ever predicts the scheduler doing MORE work
-    than lockstep (a policy bug, not a modeling error)."""
+    block-segments per workload shape, plus the launch cost — host
+    barriers and device programs per run (0 / 1 on the fused path,
+    n_intervals / n_intervals on the PR-5 host loop).  Returns
+    (table, rc) — rc is nonzero if the model ever predicts the
+    scheduler doing MORE work than lockstep (a policy bug, not a
+    modeling error)."""
     from hpa2_tpu.utils.trace import heterogeneous_lengths
 
     r = resident if resident else batch
     lines = [
         f"Occupancy scheduler model  (batch={batch} resident={r} "
         f"block={block} window={window} max_instrs={max_instrs} "
-        f"threshold={threshold} groups={groups})",
+        f"threshold={threshold} groups={groups} fused={fused})",
         f"{'dist':>8} {'spread':>6} {'lockstep':>9} {'scheduled':>9} "
-        f"{'speedup':>8} {'live%':>6} {'compact':>7} {'admit':>6}",
+        f"{'speedup':>8} {'live%':>6} {'compact':>7} {'admit':>6} "
+        f"{'barrier':>7} {'progrm':>6}",
     ]
     rc = 0
     for dist in dists:
@@ -85,7 +94,7 @@ def occupancy_table(
             )
             st = predicted_stats(
                 lens, window, block, resident=resident, groups=groups,
-                threshold=threshold,
+                threshold=threshold, fused=fused,
             )
             if st.block_segments > st.lockstep_block_segments:
                 rc = 1
@@ -94,6 +103,7 @@ def occupancy_table(
                 f"{st.lockstep_block_segments:>9} "
                 f"{st.block_segments:>9} {st.speedup:>7.2f}x "
                 f"{100 * st.mean_live_fraction:>5.1f} "
-                f"{st.compactions:>7} {st.admissions:>6}"
+                f"{st.compactions:>7} {st.admissions:>6} "
+                f"{st.host_barriers:>7} {st.device_programs:>6}"
             )
     return "\n".join(lines), rc

@@ -8,8 +8,9 @@ trace window, mailbox capacity, sharer words, gate, snapshots,
 streaming on/off — WITHOUT compiling anything, so budget regressions
 fail in tier-1 unit tests instead of on a dead TPU tunnel weeks later.
 
-Accounting (everything is an i32 plane with the lane axis minor, so a
-"row" is one i32 per lane and ``bytes = rows * block * 4``):
+Accounting (every plane has the lane axis minor, so a "row" is one
+element per lane; bytes are dtype-aware — int32 rows cost 4 bytes,
+the ``packed=True`` uint8/uint16 planes cost 1-2):
 
 * carried planes (``state_shapes``): each blocked in/out pair is
   charged ``PIPELINE_COPIES`` buffers (pallas double-buffers blocked
@@ -39,7 +40,7 @@ from hpa2_tpu.config import SystemConfig
 
 #: per-core VMEM on the target parts (v4/v5 generation: 16 MiB)
 VMEM_CAP_BYTES = 16 * 1024 * 1024
-BYTES_PER_ROW_PER_LANE = 4  # everything is i32
+BYTES_PER_ROW_PER_LANE = 4  # i32 planes (packed planes use itemsize)
 
 #: blocked pallas operands are pipelined across grid steps: one buffer
 #: being computed on, one in flight (input/output aliasing folds the
@@ -57,7 +58,9 @@ class VmemBudget:
     snapshots: bool
     gate: bool
     stream: bool
+    packed: bool
     rows: Dict[str, int]        # carried rows/lane per plane
+    lane_bytes: Dict[str, int]  # dtype-aware bytes/lane per plane
     carried_rows: int           # sum over carried (non-snapshot) planes
     snap_rows: int              # sum over snapshot planes
     trace_rows: int             # trace window rows/lane (tr + tr_len)
@@ -65,10 +68,11 @@ class VmemBudget:
     live_rows: int              # live loop-carry rows/lane
     scratch_rows: int           # DMA scratch rows/lane (streaming)
     total_rows: int             # everything, rows per lane
+    total_lane_bytes: int       # everything, BYTES per lane (dtype-aware)
 
     @property
     def total_bytes(self) -> int:
-        return self.total_rows * self.block * BYTES_PER_ROW_PER_LANE
+        return self.total_lane_bytes * self.block
 
     @property
     def fits(self) -> bool:
@@ -79,10 +83,11 @@ class VmemBudget:
         return VMEM_CAP_BYTES - self.total_bytes
 
 
-def _plane_rows(config: SystemConfig, snapshots: bool) -> Dict[str, int]:
+def _plane_rows(config: SystemConfig, snapshots: bool,
+                packed: bool = False) -> Dict[str, int]:
     from hpa2_tpu.ops.pallas_engine import state_shapes
 
-    shapes = state_shapes(config, snapshots)
+    shapes = state_shapes(config, snapshots, packed)
     rows = {}
     for name, prefix in shapes.items():
         r = 1
@@ -90,6 +95,38 @@ def _plane_rows(config: SystemConfig, snapshots: bool) -> Dict[str, int]:
             r *= d
         rows[name] = r
     return rows
+
+
+def _plane_lane_bytes(config: SystemConfig, snapshots: bool,
+                      packed: bool = False) -> Dict[str, int]:
+    """Per-plane BYTES per lane: rows times the carried dtype width
+    (all 4 for the legacy int32 layout; the packed cache/dir planes
+    drop to 1-2)."""
+    import numpy as np
+
+    from hpa2_tpu.ops.pallas_engine import state_dtypes
+
+    rows = _plane_rows(config, snapshots, packed)
+    dtypes = state_dtypes(config, snapshots, packed)
+    return {f: r * np.dtype(dtypes[f]).itemsize for f, r in rows.items()}
+
+
+#: plane-name predicate for the protocol word planes (MESI cache words
+#: + directory words, legacy or packed, plus the split-mode sharer
+#: words) — the planes the ``packed=`` flag shrinks
+_WORD_PLANES = ("cachew", "dirw", "cvalw", "cmetaw", "dmemw", "dmetaw")
+
+
+def state_plane_bytes(config: SystemConfig, *,
+                      packed: bool = False) -> int:
+    """Per-lane bytes of the MESI/dir-state/value word planes — the
+    quantity the packed layout is pinned to cut by >= 1.8x (ISSUE 6
+    acceptance)."""
+    lb = _plane_lane_bytes(config, snapshots=False, packed=packed)
+    return sum(
+        b for f, b in lb.items()
+        if f in _WORD_PLANES or f.startswith("dirs")
+    )
 
 
 def vmem_budget(
@@ -100,15 +137,22 @@ def vmem_budget(
     snapshots: bool = False,
     gate: bool = False,
     stream: bool = True,
+    packed: bool = False,
 ) -> VmemBudget:
     """Predict the per-block VMEM footprint of the run kernel."""
     n = config.num_procs
-    rows = _plane_rows(config, snapshots)
+    rows = _plane_rows(config, snapshots, packed)
+    lane_bytes = _plane_lane_bytes(config, snapshots, packed)
     snap_rows = sum(r for f, r in rows.items() if f.startswith("snap_"))
     carried_rows = sum(
         r for f, r in rows.items() if not f.startswith("snap_")
     )
+    snap_b = sum(b for f, b in lane_bytes.items() if f.startswith("snap_"))
+    carried_b = sum(
+        b for f, b in lane_bytes.items() if not f.startswith("snap_")
+    )
     trace_rows = n * window + n  # tr + tr_len
+    trace_b = trace_rows * BYTES_PER_ROW_PER_LANE  # trace stays int32
 
     live_copies = 2 if gate else 1
 
@@ -116,22 +160,33 @@ def vmem_budget(
         # blocked operands: carried state + tr_len + the status plane
         # (trace and snapshot planes moved to HBM: zero blocked copies)
         operand = (carried_rows + n + 1) * PIPELINE_COPIES
+        operand_b = (
+            carried_b + (n + 1) * BYTES_PER_ROW_PER_LANE
+        ) * PIPELINE_COPIES
         # the window plane is closed over by the burst loops, not
         # carried — one live copy regardless of the gate's lax.cond
         live = (carried_rows + snap_rows) * live_copies + trace_rows
+        live_b = (carried_b + snap_b) * live_copies + trace_b
         # 2-slot trace double buffer; snapshots staged in 1-copy scratch
         scratch = 2 * n * window + snap_rows
+        scratch_b = 2 * n * window * BYTES_PER_ROW_PER_LANE + snap_b
     else:
         operand = (carried_rows + snap_rows + trace_rows) * PIPELINE_COPIES
+        operand_b = (carried_b + snap_b + trace_b) * PIPELINE_COPIES
         live = (carried_rows + snap_rows + trace_rows) * live_copies
+        live_b = (carried_b + snap_b + trace_b) * live_copies
         scratch = 0
+        scratch_b = 0
 
     total = operand + live + scratch
+    total_b = operand_b + live_b + scratch_b
     return VmemBudget(
         config=config, block=block, window=window, snapshots=snapshots,
-        gate=gate, stream=stream, rows=rows, carried_rows=carried_rows,
+        gate=gate, stream=stream, packed=packed, rows=rows,
+        lane_bytes=lane_bytes, carried_rows=carried_rows,
         snap_rows=snap_rows, trace_rows=trace_rows, operand_rows=operand,
         live_rows=live, scratch_rows=scratch, total_rows=total,
+        total_lane_bytes=total_b,
     )
 
 
@@ -146,15 +201,16 @@ def budget_table(
     *,
     snapshots: bool = False,
     gate: bool = False,
+    packed: bool = False,
 ) -> str:
     """The ``analysis vmem`` report: streamed vs legacy footprint per
     block width against the 16 MiB cap."""
     lines = [
         f"VMEM budget model  (n={config.num_procs} cap="
         f"{config.msg_buffer_size} window={window} "
-        f"snapshots={snapshots} gate={gate}; cap "
+        f"snapshots={snapshots} gate={gate} packed={packed}; cap "
         f"{_fmt_mb(VMEM_CAP_BYTES).strip()} MiB)",
-        f"{'block':>6} {'mode':>8} {'rows/lane':>10} {'MiB':>7} "
+        f"{'block':>6} {'mode':>8} {'B/lane':>8} {'MiB':>7} "
         f"{'headroom':>9}  fits",
     ]
     for block in blocks:
@@ -162,10 +218,11 @@ def budget_table(
             bud = vmem_budget(
                 config, block, window,
                 snapshots=snapshots, gate=gate, stream=stream,
+                packed=packed,
             )
             lines.append(
                 f"{block:>6} {'stream' if stream else 'legacy':>8} "
-                f"{bud.total_rows:>10} {_fmt_mb(bud.total_bytes)} "
+                f"{bud.total_lane_bytes:>8} {_fmt_mb(bud.total_bytes)} "
                 f"{_fmt_mb(bud.headroom_bytes)}  "
                 f"{'yes' if bud.fits else 'NO'}"
             )

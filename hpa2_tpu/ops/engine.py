@@ -393,6 +393,33 @@ def build_batched_run_chunk(config: SystemConfig, chunk: int):
     return jax.jit(run)
 
 
+@functools.lru_cache(maxsize=16)
+def build_fused_batched_run(config: SystemConfig,
+                            max_cycles: int = 1_000_000,
+                            watchdog_cycles: int = 0):
+    """The fused scheduled run for the vmapped backend: ONE jitted
+    program scans the precomputed wave plan — each wave is a stacked
+    batch of ``resident`` rows driven to quiescence by the exact
+    unscheduled :func:`build_batched_run` while-loop — then gathers
+    every system's harvest-time row out of the stacked wave results.
+    Rows are independent, so waves-to-quiescence is bit-exact with the
+    PR-5 host chunk loop by construction, with zero host barriers.
+
+    ``xs`` is the wave-stacked initial state ([n_waves, r, ...] on
+    every leaf); ``sys_src[b]`` flat-indexes (wave * r + row) the row
+    that carried system ``b``."""
+    run = build_batched_run(config, max_cycles, watchdog_cycles)
+
+    def fused(xs: SimState, sys_src) -> SimState:
+        _, outs = jax.lax.scan(lambda c, w: (c, run(w)), 0, xs)
+        flat = jax.tree_util.tree_map(
+            lambda x: x.reshape((-1,) + x.shape[2:]), outs
+        )
+        return jax.tree_util.tree_map(lambda x: x[sys_src], flat)
+
+    return jax.jit(fused)
+
+
 class BatchJaxEngine:
     """An ensemble of B independent systems (vmap over the batch axis).
 
@@ -417,6 +444,12 @@ class BatchJaxEngine:
     its cohort drains).  Requires ``snapshots`` semantics unchanged;
     ``self.occupancy`` holds the
     :class:`~hpa2_tpu.ops.schedule.OccupancyStats` after the run.
+
+    ``Schedule(fused=True)`` (the default) runs the whole scheduled
+    ensemble as ONE device program (:func:`build_fused_batched_run`):
+    a ``lax.scan`` over precomputed admission waves of ``resident``
+    rows, zero host barriers, occupancy stats from the static replay
+    model.  ``fused=False`` keeps the PR-5 host chunk loop.
     """
 
     def __init__(
@@ -494,6 +527,8 @@ class BatchJaxEngine:
 
     def run(self) -> "BatchJaxEngine":
         if self.schedule is not None:
+            if self.schedule.fused:
+                return self._run_scheduled_fused()
             return self._run_scheduled()
         st = self._run(self.state)
         st = jax.tree_util.tree_map(lambda x: x.block_until_ready(), st)
@@ -503,6 +538,88 @@ class BatchJaxEngine:
         vq = np.asarray(jax.vmap(quiescent)(st))
         if not vq.all():
             raise self._batch_stall(vq)
+        return self
+
+    def _run_scheduled_fused(self) -> "BatchJaxEngine":
+        """The fused scheduled run: ONE device program consumes a
+        precomputed wave plan (rows independent -> run each wave of
+        ``resident`` rows to quiescence, ``lax.scan`` over waves) —
+        zero host barriers.  Dumps and activity counters are bit-exact
+        vs the host chunk loop and vs unscheduled (per-system ``cycle``
+        stays non-invariant here, exactly as in the PR-5 path)."""
+        cfg = self.config
+        r, b = self._resident, self.b
+        groups = self.data_shards
+        gl, gs = r // groups, b // groups
+        n_waves = -(-gs // gl)
+        # wave plan: group g's rows sweep its contiguous system slice
+        # gl at a time — exactly the admission order of the PR-5
+        # host-loop queues (row order within group, group-local)
+        wave_sys = np.full((n_waves, r), -1, dtype=np.int64)
+        for g in range(groups):
+            for k in range(n_waves):
+                base = g * gs + k * gl
+                cnt = max(0, min(gl, (g + 1) * gs - base))
+                wave_sys[k, g * gl:g * gl + cnt] = np.arange(
+                    base, base + cnt
+                )
+
+        empty_traces = [[] for _ in range(cfg.num_procs)]
+
+        def fresh(s):
+            traces = self._batch_traces[s] if s >= 0 else empty_traces
+            return init_state(cfg, traces, max_trace_len=self._max_t)
+
+        # dead rows (final partial wave) carry an empty-trace state:
+        # quiescent from cycle 0, so they never hold a wave open, and
+        # their results are not gathered
+        xs = jax.tree_util.tree_map(
+            lambda *a: jnp.stack(a),
+            *[
+                stack_states([fresh(s) for s in wave_sys[k]])
+                for k in range(n_waves)
+            ],
+        )
+        sys_src = np.empty(b, dtype=np.int64)
+        for k in range(n_waves):
+            live = wave_sys[k] >= 0
+            sys_src[wave_sys[k][live]] = k * r + np.nonzero(live)[0]
+        if self.mesh is not None:
+            from hpa2_tpu.parallel.sharding import _place, state_specs
+
+            from jax.sharding import PartitionSpec as P
+
+            wave_specs = jax.tree_util.tree_map(
+                lambda s: P(None, *s), state_specs(batched=True)
+            )
+            xs = _place(xs, self.mesh, wave_specs)
+        runner = build_fused_batched_run(
+            cfg, self.max_cycles, self.watchdog_cycles
+        )
+        st = runner(xs, jnp.asarray(sys_src))
+        st = jax.tree_util.tree_map(lambda x: x.block_until_ready(), st)
+        if self.mesh is not None:
+            from hpa2_tpu.parallel.sharding import _place, state_specs
+
+            st = _place(st, self.mesh, state_specs(batched=True))
+        self.state = st
+        if bool(jnp.any(st.overflow)):
+            raise StallError(
+                "internal invariant violated: mailbox overflow despite "
+                "backpressure"
+            )
+        vq = np.asarray(jax.vmap(quiescent)(st))
+        if not vq.all():
+            raise self._batch_stall(vq)
+        # occupancy stats flow from the same static replay model the
+        # plan builder uses — one segment per system per wave
+        from hpa2_tpu.ops.schedule import simulate
+
+        self.occupancy = simulate(
+            np.ones(b, dtype=np.int64), resident=r, block=1,
+            groups=groups, threshold=self.schedule.threshold,
+            fused=True,
+        )
         return self
 
     def _run_scheduled(self) -> "BatchJaxEngine":
@@ -597,7 +714,7 @@ class BatchJaxEngine:
         # invert the row->system assignment history: full-ensemble
         # state in system order, so all readback works unchanged
         self.state = place(stack_states(store))
-        self.occupancy = stats
+        self.occupancy = stats.set_mode(fused=False)
         return self
 
     def _batch_stall(self, vq: np.ndarray) -> Exception:

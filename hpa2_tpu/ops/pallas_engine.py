@@ -262,19 +262,132 @@ def _scalar_layout(config: SystemConfig, t_dim: int):
     }
 
 
+# ---------------------------------------------------------------------------
+# Packed state planes (the VMEM-rent halving): in ``packed=True`` mode
+# the two word planes that dominate carried rows split into narrow
+# unsigned planes —
+#
+#     cachew [N,C] i32  ->  cvalw  [N,C] u8   (the value byte)
+#                           cmetaw [N,C] u8/u16 (state | (addr+1)<<2)
+#     dirw   [N,M] i32  ->  dmemw  [N,M] u8   (the memory byte)
+#                           dmetaw [N,M] u8/u16 (dir_state | sharers<<2)
+#
+# and their snapshot twins likewise.  The cycle body is UNCHANGED: at
+# cycle entry the narrow planes are promoted and recombined into the
+# exact legacy words through the sanctioned ``_widen`` helper, and at
+# cycle exit the words are re-split through ``_narrow`` — so packed
+# runs are bit-exact by construction, and the narrow dtypes are what
+# the loop carries (where the VMEM rent is paid).  The AST lint
+# (analysis/lint.py, dtype-widening rule) flags any op that touches a
+# packed plane without going through ``_widen`` first.
+# ---------------------------------------------------------------------------
+
+_PACKED_CACHE = ("cvalw", "cmetaw")
+_PACKED_DIR = ("dmemw", "dmetaw")
+
+
+def _meta_dtype(bits: int):
+    """Narrowest unsigned dtype holding ``bits`` bits, or None when
+    only int32 would fit (no byte win -> packing unsupported)."""
+    if bits <= 8:
+        return np.dtype(np.uint8)
+    if bits <= 16:
+        return np.dtype(np.uint16)
+    return None
+
+
+def packed_plane_dtypes(config: SystemConfig):
+    """Dtypes of the four packed planes, or raise when the meta fields
+    cannot narrow below int32 (packing would then only add planes)."""
+    cmeta_bits = 2 + _bits_for(config.num_addresses + 1)
+    # below 22 nodes the sharer mask shares the directory word; in
+    # split-plane mode the dirs{w} planes carry it and dmetaw holds
+    # only the 2-bit directory state
+    dmeta_bits = 2 + (0 if _split_mode(config) else config.num_procs)
+    cd, dd = _meta_dtype(cmeta_bits), _meta_dtype(dmeta_bits)
+    if cd is None or dd is None:
+        raise ValueError(
+            f"packed planes need cache meta <= 16 bits (got "
+            f"{cmeta_bits}: num_addresses={config.num_addresses}) and "
+            f"dir meta <= 16 bits (got {dmeta_bits}: num_procs="
+            f"{config.num_procs}); run this geometry with packed=False"
+        )
+    return {
+        "cvalw": np.dtype(np.uint8), "cmetaw": cd,
+        "dmemw": np.dtype(np.uint8), "dmetaw": dd,
+    }
+
+
+def _widen(x) -> jnp.ndarray:
+    """THE sanctioned promotion of a packed (u8/u16) plane to the i32
+    the cycle body computes in.  Packed planes hold nonnegative bit
+    patterns, so the zero-extend is exact."""
+    return x.astype(I32)
+
+
+def _narrow(x, dtype) -> jnp.ndarray:
+    """THE sanctioned demotion back to a packed plane's storage dtype
+    (the value is a bit pattern that fits by construction)."""
+    return x.astype(dtype)
+
+
+def _widen_cache(cvalw, cmetaw) -> jnp.ndarray:
+    """Packed cache planes -> the legacy cachew word."""
+    cv, cm = _widen(cvalw), _widen(cmetaw)
+    return (cm & 3) | (cv << _CW_VAL_SHIFT) | (
+        (cm >> 2) << _CW_ADDR_SHIFT
+    )
+
+
+def _narrow_cache(cachew, meta_dtype):
+    """The legacy cachew word -> (cvalw, cmetaw).  The word has no
+    bits above the addr field, so ``>> _CW_ADDR_SHIFT`` is exact."""
+    cvalw = _narrow((cachew >> _CW_VAL_SHIFT) & 0xFF, jnp.uint8)
+    cmetaw = _narrow(
+        (cachew & 3) | ((cachew >> _CW_ADDR_SHIFT) << 2), meta_dtype
+    )
+    return cvalw, cmetaw
+
+
+def _widen_dir(dmemw, dmetaw) -> jnp.ndarray:
+    """Packed directory planes -> the legacy dirw word."""
+    dm, dmt = _widen(dmemw), _widen(dmetaw)
+    return dm | ((dmt & 3) << _DW_STATE_SHIFT) | (
+        (dmt >> 2) << _DW_SH_SHIFT
+    )
+
+
+def _narrow_dir(dirw, meta_dtype):
+    dmemw = _narrow(dirw & 0xFF, jnp.uint8)
+    dmetaw = _narrow(
+        ((dirw >> _DW_STATE_SHIFT) & 3)
+        | ((dirw >> _DW_SH_SHIFT) << 2),
+        meta_dtype,
+    )
+    return dmemw, dmetaw
+
+
 #: per-engine carried state names, in kernel argument order
 def _state_fields(W: int, snapshots: bool, recv_packed: bool,
-                  split_sw: int = 0):
+                  split_sw: int = 0, packed: bool = False):
     """``split_sw`` > 0 adds the split-plane sharer words (dirs{w},
-    plus their snapshot twins)."""
-    f = ["cachew", "dirw"]
+    plus their snapshot twins); ``packed`` swaps the cachew/dirw word
+    planes (and snapshot twins) for their narrow split planes."""
+    f = (
+        list(_PACKED_CACHE + _PACKED_DIR) if packed
+        else ["cachew", "dirw"]
+    )
     f += [f"dirs{w}" for w in range(split_sw)]
     f += [f"mb{w}" for w in range(W)]
     f += ["nsw"]  # packed mb_count | waiting | pending_write | pc
     f += [f"ob{w}" for w in range(W)]
     f += [] if recv_packed else ["ob_recv"]
     if snapshots:
-        f += ["snap_taken", "snap_cachew", "snap_dirw"]
+        f += ["snap_taken"]
+        f += (
+            [f"snap_{p}" for p in _PACKED_CACHE + _PACKED_DIR]
+            if packed else ["snap_cachew", "snap_dirw"]
+        )
         f += [f"snap_dirs{w}" for w in range(split_sw)]
     f += ["scalars", "msg_counts"]
     return tuple(f)
@@ -314,7 +427,8 @@ def deferred_valid(config: SystemConfig, s) -> jnp.ndarray:
 TRACE_FIELDS = ("tr", "tr_len")
 
 
-def state_shapes(config: SystemConfig, snapshots: bool):
+def state_shapes(config: SystemConfig, snapshots: bool,
+                 packed: bool = False):
     """Per-field carried-state shapes WITHOUT the trailing lane axis.
     Single source of truth for the kernel builders and the static
     VMEM budget model (hpa2_tpu/analysis/vmem.py)."""
@@ -322,18 +436,30 @@ def state_shapes(config: SystemConfig, snapshots: bool):
     cap, nt = config.msg_buffer_size, _NTYPES
     layout, W = _mb_layout(config)
     split_sw = _sharer_words(config) if _split_mode(config) else 0
-    shapes = {
-        "cachew": (n, c), "dirw": (n, m),
+    if packed:
+        shapes = {
+            "cvalw": (n, c), "cmetaw": (n, c),
+            "dmemw": (n, m), "dmetaw": (n, m),
+        }
+    else:
+        shapes = {"cachew": (n, c), "dirw": (n, m)}
+    shapes.update({
         "nsw": (n,),
         "scalars": (_NSCALAR,), "msg_counts": (nt,),
-    }
+    })
     if "recv" not in layout:
         shapes["ob_recv"] = (n, _NSLOTS)
     if snapshots:
-        shapes.update({
-            "snap_taken": (n,), "snap_cachew": (n, c),
-            "snap_dirw": (n, m),
-        })
+        shapes["snap_taken"] = (n,)
+        if packed:
+            shapes.update({
+                "snap_cvalw": (n, c), "snap_cmetaw": (n, c),
+                "snap_dmemw": (n, m), "snap_dmetaw": (n, m),
+            })
+        else:
+            shapes.update({
+                "snap_cachew": (n, c), "snap_dirw": (n, m),
+            })
     for w in range(split_sw):
         shapes[f"dirs{w}"] = (n, m)
         if snapshots:
@@ -342,6 +468,22 @@ def state_shapes(config: SystemConfig, snapshots: bool):
         shapes[f"mb{w}"] = (n, cap)
         shapes[f"ob{w}"] = (n, _NSLOTS)
     return shapes
+
+
+def state_dtypes(config: SystemConfig, snapshots: bool,
+                 packed: bool = False):
+    """Per-field carried-state numpy dtypes — int32 everywhere except
+    the packed planes (and their snapshot twins)."""
+    dtypes = {
+        f: np.dtype(np.int32)
+        for f in state_shapes(config, snapshots, packed)
+    }
+    if packed:
+        for f, dt in packed_plane_dtypes(config).items():
+            dtypes[f] = dt
+            if snapshots:
+                dtypes[f"snap_{f}"] = dt
+    return dtypes
 
 
 def _popcount(x):
@@ -373,10 +515,16 @@ def _test_bit(mask, proc):
 
 
 def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
-                ablate: frozenset = frozenset()):
+                ablate: frozenset = frozenset(), packed: bool = False):
     """One lockstep cycle over a block of ``bb`` systems in transposed
     layout.  Pure jnp on a state dict — runs inside the Pallas kernel
     and, for validation, directly under jit/CPU.
+
+    ``packed``: the state dict carries the narrow packed planes
+    (cvalw/cmetaw/dmemw/dmetaw) instead of the cachew/dirw words; the
+    cycle body itself is unchanged — packed planes are ``_widen``-ed
+    into the legacy words at entry and re-``_narrow``-ed at exit, so a
+    packed cycle is bit-exact with the unpacked one by construction.
 
     ``ablate`` (perf tooling only, scripts/perf_sweep.py --ablate):
     named cycle stages are stubbed out to attribute per-cycle time on
@@ -1280,7 +1428,31 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         out["msg_counts"] = s["msg_counts"] + mc
         return out
 
-    return cycle
+    if not packed:
+        return cycle
+
+    pdt = packed_plane_dtypes(config)
+
+    def packed_cycle(s: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        wide = dict(s)
+        for pre in ([""] + (["snap_"] if snapshots else [])):
+            wide[f"{pre}cachew"] = _widen_cache(
+                wide.pop(f"{pre}cvalw"), wide.pop(f"{pre}cmetaw")
+            )
+            wide[f"{pre}dirw"] = _widen_dir(
+                wide.pop(f"{pre}dmemw"), wide.pop(f"{pre}dmetaw")
+            )
+        out = cycle(wide)
+        for pre in ([""] + (["snap_"] if snapshots else [])):
+            cv, cm = _narrow_cache(
+                out.pop(f"{pre}cachew"), pdt["cmetaw"]
+            )
+            dm, dmt = _narrow_dir(out.pop(f"{pre}dirw"), pdt["dmetaw"])
+            out[f"{pre}cvalw"], out[f"{pre}cmetaw"] = cv, cm
+            out[f"{pre}dmemw"], out[f"{pre}dmetaw"] = dm, dmt
+        return out
+
+    return packed_cycle
 
 
 # ---------------------------------------------------------------------------
@@ -1311,7 +1483,44 @@ def _pack_traces(config: SystemConfig, tr_op, tr_addr, tr_val, tr_len):
     return np.ascontiguousarray(np.moveaxis(tr, 0, -1))
 
 
-def _init_state(config: SystemConfig, b: int, snapshots: bool = True):
+def _split_word_planes_np(config: SystemConfig, cachew, dirw):
+    """Numpy split of legacy cachew/dirw word planes into the four
+    packed planes (the inverse of ``_widen_cache``/``_widen_dir``)."""
+    pdt = packed_plane_dtypes(config)
+    cw = cachew.astype(np.int64)
+    dw = dirw.astype(np.int64)
+    return {
+        "cvalw": ((cw >> _CW_VAL_SHIFT) & 0xFF).astype(np.uint8),
+        "cmetaw": (
+            (cw & 3) | ((cw >> _CW_ADDR_SHIFT) << 2)
+        ).astype(pdt["cmetaw"]),
+        "dmemw": (dw & 0xFF).astype(np.uint8),
+        "dmetaw": (
+            ((dw >> _DW_STATE_SHIFT) & 3) | ((dw >> _DW_SH_SHIFT) << 2)
+        ).astype(pdt["dmetaw"]),
+    }
+
+
+def _join_word_planes_np(cvalw, cmetaw, dmemw, dmetaw):
+    """Numpy inverse of :func:`_split_word_planes_np` — rebuild legacy
+    int32 cachew/dirw words for readback/dump decoding."""
+    cm = cmetaw.astype(np.int64)
+    dmt = dmetaw.astype(np.int64)
+    cachew = (
+        (cm & 3)
+        | (cvalw.astype(np.int64) << _CW_VAL_SHIFT)
+        | ((cm >> 2) << _CW_ADDR_SHIFT)
+    ).astype(np.int32)
+    dirw = (
+        dmemw.astype(np.int64)
+        | ((dmt & 3) << _DW_STATE_SHIFT)
+        | ((dmt >> 2) << _DW_SH_SHIFT)
+    ).astype(np.int32)
+    return cachew, dirw
+
+
+def _init_state(config: SystemConfig, b: int, snapshots: bool = True,
+                packed: bool = False):
     """Initial packed state dict in transposed layout
     (initializeProcessor semantics, assignment.c:776-822)."""
     n, c, m = config.num_procs, config.cache_size, config.mem_size
@@ -1328,14 +1537,22 @@ def _init_state(config: SystemConfig, b: int, snapshots: bool = True):
     ).copy()
     # invalid line: state I, value 0, addr -1 (stored +1 = 0)
     cachew0 = np.full((n, c, b), _I, np.int32)
+
+    def words(cw, dw, prefix=""):
+        if packed:
+            return {
+                f"{prefix}{f}": v
+                for f, v in _split_word_planes_np(config, cw, dw).items()
+            }
+        return {f"{prefix}cachew": cw, f"{prefix}dirw": dw}
+
     z2 = np.zeros((n, b), dtype=np.int32)
-    state = {
-        "cachew": cachew0.copy(),
-        "dirw": dirw0,
+    state = dict(words(cachew0.copy(), dirw0))
+    state.update({
         "nsw": z2.copy(),  # mb_count | waiting | pending_write | pc
         "scalars": np.zeros((_NSCALAR, b), np.int32),
         "msg_counts": np.zeros((_NTYPES, b), np.int32),
-    }
+    })
     split_sw = _sharer_words(config) if _split_mode(config) else 0
     for w in range(split_sw):
         state[f"dirs{w}"] = np.zeros((n, m, b), np.int32)
@@ -1346,11 +1563,8 @@ def _init_state(config: SystemConfig, b: int, snapshots: bool = True):
         # -1 = empty (deferred_valid's point-slot sentinel)
         state["ob_recv"] = np.full((n, _NSLOTS, b), -1, np.int32)
     if snapshots:
-        state.update({
-            "snap_taken": z2.copy(),
-            "snap_cachew": cachew0.copy(),
-            "snap_dirw": dirw0.copy(),
-        })
+        state["snap_taken"] = z2.copy()
+        state.update(words(cachew0.copy(), dirw0.copy(), "snap_"))
         for w in range(split_sw):
             state[f"snap_dirs{w}"] = np.zeros((n, m, b), np.int32)
     return state
@@ -1359,7 +1573,8 @@ def _init_state(config: SystemConfig, b: int, snapshots: bool = True):
 @functools.lru_cache(maxsize=16)
 def _build_call(config: SystemConfig, b: int, bb: int, k: int,
                 interpret: bool, snapshots: bool,
-                ablate: frozenset = frozenset(), gate: bool = True):
+                ablate: frozenset = frozenset(), gate: bool = True,
+                packed: bool = False):
     """Jitted pallas_call advancing every system by up to ``k`` cycles
     (quiesced blocks skip at ``_GATE`` granularity), state resident in
     VMEM for the duration."""
@@ -1368,13 +1583,15 @@ def _build_call(config: SystemConfig, b: int, bb: int, k: int,
 
     if b % bb != 0:
         raise ValueError(f"batch {b} not divisible by block {bb}")
-    cycle = build_cycle(config, bb, snapshots, ablate)
+    cycle = build_cycle(config, bb, snapshots, ablate, packed)
     n = config.num_procs
     layout, W = _mb_layout(config)
     split_sw = _sharer_words(config) if _split_mode(config) else 0
-    fields = _state_fields(W, snapshots, "recv" in layout, split_sw)
+    fields = _state_fields(W, snapshots, "recv" in layout, split_sw,
+                           packed)
     outer, inner = -(-k // _GATE), _GATE
-    shapes = state_shapes(config, snapshots=True)
+    shapes = state_shapes(config, snapshots=True, packed=packed)
+    dtypes = state_dtypes(config, snapshots=True, packed=packed)
 
     def kernel(*refs):
         ntr = len(TRACE_FIELDS)
@@ -1436,7 +1653,7 @@ def _build_call(config: SystemConfig, b: int, bb: int, k: int,
         )
         out_specs = [block_spec(shapes[f]) for f in fields]
         out_shape = [
-            jax.ShapeDtypeStruct(tuple(shapes[f]) + (b,), jnp.int32)
+            jax.ShapeDtypeStruct(tuple(shapes[f]) + (b,), dtypes[f])
             for f in fields
         ]
         aliases = {
@@ -1461,17 +1678,17 @@ def _build_call(config: SystemConfig, b: int, bb: int, k: int,
 
 
 @functools.lru_cache(maxsize=16)
-def _build_run(config: SystemConfig, b: int, bb: int, k: int,
-               interpret: bool, snapshots: bool, window: int, n_seg: int,
-               max_calls: int, ablate: frozenset = frozenset(),
-               gate: bool = True):
+def _make_run(config: SystemConfig, b: int, bb: int, k: int,
+              interpret: bool, snapshots: bool, window: int, n_seg: int,
+              max_calls: int, ablate: frozenset = frozenset(),
+              gate: bool = True, packed: bool = False):
     """One jitted program driving the WHOLE run on-device: fori over
     trace windows x while-to-quiescence around the pallas_call, one
     status scalar out.  Host<->device round trips through the axon
     tunnel cost ~10^2 ms each (measured round 4); the per-call python
     loop was paying two per 128 cycles, dwarfing the kernel itself."""
     call = _build_call(config, b, bb, k, interpret, snapshots, ablate,
-                       gate)
+                       gate, packed)
     slsc = _scalar_layout(config, window)
 
     def all_quiescent(st, tl):
@@ -1525,15 +1742,28 @@ def _build_run(config: SystemConfig, b: int, bb: int, k: int,
         )
         return state, status
 
-    return jax.jit(run_all)
+    return run_all
 
 
 @functools.lru_cache(maxsize=16)
-def _build_stream_run(config: SystemConfig, b: int, bb: int, k: int,
-                      interpret: bool, snapshots: bool, window: int,
-                      n_seg: int, max_calls: int,
-                      ablate: frozenset = frozenset(),
-                      gate: bool = True):
+def _build_run(config: SystemConfig, b: int, bb: int, k: int,
+               interpret: bool, snapshots: bool, window: int, n_seg: int,
+               max_calls: int, ablate: frozenset = frozenset(),
+               gate: bool = True, packed: bool = False):
+    """Jitted wrapper around :func:`_make_run` (the raw program is
+    cached separately so the fused scheduled runner can embed the
+    SAME interval program inside its scan — identity, not equality)."""
+    return jax.jit(_make_run(config, b, bb, k, interpret, snapshots,
+                             window, n_seg, max_calls, ablate, gate,
+                             packed))
+
+
+@functools.lru_cache(maxsize=16)
+def _make_stream_run(config: SystemConfig, b: int, bb: int, k: int,
+                     interpret: bool, snapshots: bool, window: int,
+                     n_seg: int, max_calls: int,
+                     ablate: frozenset = frozenset(),
+                     gate: bool = True, packed: bool = False):
     """The HBM-streaming run program: ONE pallas_call drives the whole
     run (fori over trace windows x while-to-quiescence), with the
     windowed trace plane living in HBM (``memory_space=pltpu.ANY``)
@@ -1552,12 +1782,14 @@ def _build_stream_run(config: SystemConfig, b: int, bb: int, k: int,
 
     if b % bb != 0:
         raise ValueError(f"batch {b} not divisible by block {bb}")
-    cycle = build_cycle(config, bb, snapshots, ablate)
+    cycle = build_cycle(config, bb, snapshots, ablate, packed)
     n = config.num_procs
     layout, W = _mb_layout(config)
     split_sw = _sharer_words(config) if _split_mode(config) else 0
-    fields = _state_fields(W, snapshots, "recv" in layout, split_sw)
-    shapes = state_shapes(config, snapshots=True)
+    fields = _state_fields(W, snapshots, "recv" in layout, split_sw,
+                           packed)
+    shapes = state_shapes(config, snapshots=True, packed=packed)
+    dtypes = state_dtypes(config, snapshots=True, packed=packed)
     slsc = _scalar_layout(config, window)
     outer, inner = -(-k // _GATE), _GATE
     # snapshot planes stream; everything else stays VMEM-resident
@@ -1731,11 +1963,11 @@ def _build_stream_run(config: SystemConfig, b: int, bb: int, k: int,
     )
     out_shape = (
         [
-            jax.ShapeDtypeStruct(tuple(shapes[f]) + (b,), jnp.int32)
+            jax.ShapeDtypeStruct(tuple(shapes[f]) + (b,), dtypes[f])
             for f in vmem_fields
         ]
         + [
-            jax.ShapeDtypeStruct(tuple(shapes[f]) + (b,), jnp.int32)
+            jax.ShapeDtypeStruct(tuple(shapes[f]) + (b,), dtypes[f])
             for f in snap_fields
         ]
         + [jax.ShapeDtypeStruct((1, b), jnp.int32)]
@@ -1747,7 +1979,7 @@ def _build_stream_run(config: SystemConfig, b: int, bb: int, k: int,
     ]
     if snapshots:
         scratch_shapes += [
-            pltpu.VMEM(tuple(shapes[f]) + (bb,), jnp.int32)
+            pltpu.VMEM(tuple(shapes[f]) + (bb,), dtypes[f])
             for f in snap_fields
         ]
         scratch_shapes += [pltpu.SemaphoreType.DMA((nsnap,))]
@@ -1780,7 +2012,131 @@ def _build_stream_run(config: SystemConfig, b: int, bb: int, k: int,
         )
         return new_state, status
 
-    return jax.jit(run_all)
+    return run_all
+
+
+@functools.lru_cache(maxsize=16)
+def _build_stream_run(config: SystemConfig, b: int, bb: int, k: int,
+                      interpret: bool, snapshots: bool, window: int,
+                      n_seg: int, max_calls: int,
+                      ablate: frozenset = frozenset(),
+                      gate: bool = True, packed: bool = False):
+    """Jitted wrapper around :func:`_make_stream_run` (the raw program
+    is cached separately so the fused scheduled runner can embed the
+    SAME interval program inside its scan — identity, not equality)."""
+    return jax.jit(_make_stream_run(config, b, bb, k, interpret,
+                                    snapshots, window, n_seg, max_calls,
+                                    ablate, gate, packed))
+
+
+@functools.lru_cache(maxsize=16)
+def _make_fused_run(config: SystemConfig, r: int, bsys: int, bb: int,
+                    k: int, interpret: bool, window: int, nseg_max: int,
+                    max_calls: int, ablate: frozenset = frozenset(),
+                    gate: bool = True, stream: bool = True,
+                    packed: bool = False):
+    """The fused scheduled run: the WHOLE multi-interval scheduled run
+    as one traceable program — ``lax.scan`` over the precomputed
+    :class:`~hpa2_tpu.ops.schedule.SchedulePlan` rows, with the PR-5
+    barrier transform (gather-permute compaction + admission resets)
+    applied on-device between intervals.  Each scan step runs the
+    EXACT single-interval program (the same cached
+    :func:`_make_stream_run`/:func:`_make_run` object the host-barrier
+    path jits), so the cycle loop is bit-identical by construction and
+    the compaction ops are confined to the barrier step.
+
+    Returns raw (unjitted) ``fused(state, tr_full, tr_len_full, sys,
+    seg, perm, reset) -> (state_by_system [..., bsys], status)``:
+
+    - ``state``: initial carried state over the ``r`` resident lanes.
+    - ``tr_full``/``tr_len_full``: the FULL packed trace planes over
+      all ``bsys`` systems ([n, nseg_max*window, bsys] / [n, bsys]).
+    - plan rows, all [n_int, r] int32: ``sys``/``seg`` = system id
+      (-1 = idle lane) and starting segment per lane per interval;
+      ``perm``/``reset`` = the barrier applied BEFORE that interval.
+
+    Per interval the step gathers each lane's trace window from the
+    pre-transposed plane, runs the interval program, and scatters
+    every live lane's state to its system column of the result (a
+    lane's state only changes while its system is resident, so the
+    last scatter holds exactly the harvest-time value; idle lanes
+    scatter to a trash column that is dropped).  Dead lanes read a
+    clamped (valid) trace window with ``tr_len = 0`` — every trace use
+    is eligibility-gated, so the content is inert, exactly as the
+    zero-padded windows of the host-barrier path."""
+    raw = (_make_stream_run if stream else _make_run)(
+        config, r, bb, k, interpret, False, window, 1, max_calls,
+        ablate, gate, packed
+    )
+    n = config.num_procs
+    layout, W = _mb_layout(config)
+    split_sw = _sharer_words(config) if _split_mode(config) else 0
+    fields = _state_fields(W, False, "recv" in layout, split_sw, packed)
+    shapes = state_shapes(config, snapshots=False, packed=packed)
+    dtypes = state_dtypes(config, snapshots=False, packed=packed)
+    init_np = _init_state(config, r, snapshots=False, packed=packed)
+
+    def fused(state, tr_full, tr_len_full, sys, seg, perm, reset):
+        init = {f: jnp.asarray(init_np[f]) for f in fields}
+        # [n, nseg_max*w, bsys] -> [nseg_max*bsys, n, w]: one gather
+        # row per (segment, system), so a lane's window is one
+        # dynamic-index take inside the scan
+        trf = jnp.transpose(
+            tr_full.reshape(n, nseg_max, window, bsys), (1, 3, 0, 2)
+        ).reshape(nseg_max * bsys, n, window)
+        store = {
+            f: jnp.zeros(tuple(shapes[f]) + (bsys + 1,), dtypes[f])
+            for f in fields
+        }
+
+        def step(carry, xs):
+            st, acc, status = carry
+            sys_i, seg_i, perm_i, reset_i = xs
+            # the PR-5 barrier transform, verbatim: gather-permute
+            # compaction, then fresh init at the admitted lanes
+            st = {
+                f: jnp.where(
+                    reset_i != 0, init[f], jnp.take(v, perm_i, axis=-1)
+                )
+                for f, v in st.items()
+            }
+            sysc = jnp.clip(sys_i, 0, bsys - 1)
+            gidx = jnp.clip(seg_i, 0, nseg_max - 1) * bsys + sysc
+            tr_i = jnp.transpose(trf[gidx], (1, 2, 0))
+            tl_i = jnp.where(
+                sys_i >= 0,
+                jnp.clip(
+                    tr_len_full[:, sysc] - seg_i[None, :] * window,
+                    0, window,
+                ),
+                0,
+            )
+            st, s_int = raw(st, tr_i, tl_i)
+            tgt = jnp.where(sys_i >= 0, sys_i, bsys)
+            acc = {
+                f: acc[f].at[..., tgt].set(st[f]) for f in fields
+            }
+            return (st, acc, status | s_int), None
+
+        (st, store, status), _ = jax.lax.scan(
+            step, (state, store, jnp.int32(0)),
+            (sys, seg, perm, reset),
+        )
+        return {f: store[f][..., :bsys] for f in fields}, status
+
+    return fused
+
+
+@functools.lru_cache(maxsize=16)
+def _build_fused_run(config: SystemConfig, r: int, bsys: int, bb: int,
+                     k: int, interpret: bool, window: int,
+                     nseg_max: int, max_calls: int,
+                     ablate: frozenset = frozenset(), gate: bool = True,
+                     stream: bool = True, packed: bool = False):
+    """Jitted wrapper around :func:`_make_fused_run`."""
+    return jax.jit(_make_fused_run(config, r, bsys, bb, k, interpret,
+                                   window, nseg_max, max_calls, ablate,
+                                   gate, stream, packed))
 
 
 class PallasEngine:
@@ -1808,6 +2164,15 @@ class PallasEngine:
     ``stream=False`` keeps the legacy host-composed window loop with
     the fully VMEM-resident per-call kernel.
 
+    ``packed=True`` carries the cache/directory word planes as narrow
+    uint8/uint16 split planes (cvalw/cmetaw/dmemw/dmetaw) and widens
+    them to the legacy int32 words only inside the cycle body — the
+    dominant VMEM tenants shrink ~2x, admitting ~2x the block size at
+    the same budget (``analysis vmem --packed``), with bit-exact
+    results (the widen/narrow round-trip is lossless by construction).
+    Requires cache meta (state + addr tag) and directory meta (state +
+    sharer mask) to fit 16 bits; larger geometries raise.
+
     ``schedule=Schedule(...)`` turns on the occupancy scheduler
     (hpa2_tpu/ops/schedule.py): the run becomes a host loop of
     single-segment intervals of the SAME run program (``n_seg=1``, so
@@ -1822,6 +2187,15 @@ class PallasEngine:
     accrues while a lane is active) is schedule-invariant.  Requires
     ``snapshots=False``; ``self.occupancy`` holds the measured
     :class:`~hpa2_tpu.ops.schedule.OccupancyStats` after the run.
+
+    ``Schedule(fused=True)`` (the default) drives the whole scheduled
+    run as ONE device program: the exact same interval/barrier
+    sequence is precomputed host-side by the
+    :func:`~hpa2_tpu.ops.schedule.build_plan` replay and consumed by a
+    ``lax.scan`` on-device, so there are ZERO host barriers
+    (``self.occupancy.host_barriers``) and exactly one program launch
+    — bit-exact vs ``fused=False`` (the PR-5 host-barrier loop) and vs
+    unscheduled runs.
     """
 
     def __init__(
@@ -1839,6 +2213,7 @@ class PallasEngine:
         gate: bool = True,
         stream: bool = True,
         schedule=None,
+        packed: bool = False,
         _ablate: frozenset = frozenset(),
     ):
         if interpret is None:
@@ -1853,6 +2228,9 @@ class PallasEngine:
         self.b = b
         self._interpret_active = interpret
         self._snapshots = snapshots
+        self._packed = packed
+        if packed:
+            packed_plane_dtypes(config)  # raises on unpackable geometry
         self.schedule = schedule
         self.occupancy = None
         if schedule is not None:
@@ -1878,7 +2256,7 @@ class PallasEngine:
         self.cycles_per_call = cycles_per_call
 
         tr_len = tr_len.astype(np.int32)
-        packed = _pack_traces(config, tr_op, tr_addr, tr_val, tr_len)
+        tr_words = _pack_traces(config, tr_op, tr_addr, tr_val, tr_len)
         w = trace_window if trace_window else t
         w = max(1, min(w, t))
         self._window = w
@@ -1890,19 +2268,21 @@ class PallasEngine:
             )
         t_pad = self._n_seg * w
         if t_pad != t:
-            packed = np.pad(packed, ((0, 0), (0, t_pad - t), (0, 0)))
+            tr_words = np.pad(
+                tr_words, ((0, 0), (0, t_pad - t), (0, 0))
+            )
         tr_len_nb = np.ascontiguousarray(np.moveaxis(tr_len, 0, 1))
         if schedule is not None:
             from hpa2_tpu.ops.schedule import segments_needed
 
             # host-side copies drive per-interval window assembly
-            self._tr_np = packed
+            self._tr_np = tr_words
             self._tr_len_np = tr_len_nb
             self._nseg = segments_needed(tr_len_nb, w)
             self._sched_groups = 1
-        self._tr_full = jnp.asarray(packed)
+        self._tr_full = jnp.asarray(tr_words)
         self._tr_len_full = jnp.asarray(tr_len_nb)
-        state = _init_state(config, b, snapshots)
+        state = _init_state(config, b, snapshots, packed)
         self.state = {f: jnp.asarray(v) for f, v in state.items()}
         # first-window traces, for direct _call users (perf tooling)
         self.traces = {
@@ -1917,7 +2297,7 @@ class PallasEngine:
         self._poisoned = False
         self._call = _build_call(
             config, b, self.block, cycles_per_call, interpret,
-            snapshots, _ablate, gate
+            snapshots, _ablate, gate, packed
         )
 
     def _runner(self, max_cycles: int):
@@ -1926,7 +2306,7 @@ class PallasEngine:
         return build(
             self.config, self.b, self.block, self.cycles_per_call,
             self._interpret, self._snapshots, self._window, self._n_seg,
-            max_calls, self._ablate, self._gate,
+            max_calls, self._ablate, self._gate, self._packed,
         )
 
     # -- occupancy scheduling (hpa2_tpu/ops/schedule.py) --------------
@@ -1942,7 +2322,7 @@ class PallasEngine:
         return build(
             self.config, self._resident, self.block,
             self.cycles_per_call, self._interpret, False, self._window,
-            1, max_calls, self._ablate, self._gate,
+            1, max_calls, self._ablate, self._gate, self._packed,
         )
 
     def _sched_put(self, x):
@@ -1962,7 +2342,8 @@ class PallasEngine:
         init = {
             f: jnp.asarray(v)
             for f, v in _init_state(
-                self.config, self._resident, snapshots=False
+                self.config, self._resident, snapshots=False,
+                packed=self._packed,
             ).items()
         }
 
@@ -1977,6 +2358,55 @@ class PallasEngine:
         self._barrier_cache = apply
         return apply
 
+    def _fused_runner(self, max_cycles: int):
+        """The whole-plan device program (the sharded subclass wraps
+        it in shard_map over per-shard plan slices)."""
+        max_calls = max(1, -(-max_cycles // self.cycles_per_call))
+        return _build_fused_run(
+            self.config, self._resident, self.b, self.block,
+            self.cycles_per_call, self._interpret, self._window,
+            self._n_seg, max_calls, self._ablate, self._gate,
+            self._stream, self._packed,
+        )
+
+    def _fused_plan_arrays(self, plan):
+        """Plan rows as device operands (the sharded subclass localizes
+        system/lane indices to the shard-local frame here)."""
+        return tuple(
+            jnp.asarray(x)
+            for x in (plan.sys, plan.seg, plan.perm, plan.reset)
+        )
+
+    def _run_scheduled_fused(self, max_cycles: int) -> "PallasEngine":
+        """The fused scheduled run: ONE device program consumes the
+        whole precomputed plan — zero host barriers.  Bit-exact vs the
+        host-barrier loop (the scan step applies the identical barrier
+        transform and runs the identical interval program)."""
+        from hpa2_tpu.ops.schedule import build_plan
+
+        plan = build_plan(
+            self._nseg, resident=self._resident, block=self.block,
+            groups=self._sched_groups,
+            threshold=self.schedule.threshold,
+        )
+        runner = self._fused_runner(max_cycles)
+        state = {
+            f: self._sched_put(jnp.asarray(v))
+            for f, v in _init_state(
+                self.config, self._resident, snapshots=False,
+                packed=self._packed,
+            ).items()
+        }
+        new_state, status = runner(
+            state, self._tr_full, self._tr_len_full,
+            *self._fused_plan_arrays(plan),
+        )
+        self.state = new_state
+        self._check_status(int(status), max_cycles)
+        self.occupancy = plan.stats
+        self._completed = True
+        return self
+
     def _run_scheduled(self, max_cycles: int) -> "PallasEngine":
         from hpa2_tpu.ops.schedule import LaneScheduler
 
@@ -1989,14 +2419,17 @@ class PallasEngine:
         )
         runner = self._interval_runner(max_cycles)
         fields = list(self.state.keys())
-        shapes = state_shapes(cfg, snapshots=False)
+        shapes = state_shapes(cfg, snapshots=False, packed=self._packed)
+        dtypes = state_dtypes(cfg, snapshots=False, packed=self._packed)
         store = {
-            f: np.zeros(tuple(shapes[f]) + (self.b,), np.int32)
+            f: np.zeros(tuple(shapes[f]) + (self.b,), dtypes[f])
             for f in fields
         }
         state = {
             f: self._sched_put(jnp.asarray(v))
-            for f, v in _init_state(cfg, r, snapshots=False).items()
+            for f, v in _init_state(
+                cfg, r, snapshots=False, packed=self._packed
+            ).items()
         }
         tr_np, tl_np = self._tr_np, self._tr_len_np
         arange_w = np.arange(w)
@@ -2057,7 +2490,7 @@ class PallasEngine:
         self.state = {
             f: self._sched_put(jnp.asarray(store[f])) for f in fields
         }
-        self.occupancy = sched.stats
+        self.occupancy = sched.stats.set_mode(fused=False)
         self._completed = True
         return self
 
@@ -2096,6 +2529,8 @@ class PallasEngine:
                 "rebuild the engine to retry"
             )
         if self.schedule is not None:
+            if self.schedule.fused:
+                return self._run_scheduled_fused(max_cycles)
             return self._run_scheduled(max_cycles)
         runner = self._runner(max_cycles)
         state, status = runner(
@@ -2163,24 +2598,35 @@ class PallasEngine:
             for w in range(_sharer_words(self.config))
         ]
 
+    def _word_planes(self, prefix: str = ""):
+        """(cachew, dirw) in the legacy int32 word encoding — packed
+        engines rebuild them from the narrow planes at readback."""
+        if self._packed:
+            return _join_word_planes_np(
+                np.asarray(self.state[f"{prefix}cvalw"]),
+                np.asarray(self.state[f"{prefix}cmetaw"]),
+                np.asarray(self.state[f"{prefix}dmemw"]),
+                np.asarray(self.state[f"{prefix}dmetaw"]),
+            )
+        return (
+            np.asarray(self.state[f"{prefix}cachew"]),
+            np.asarray(self.state[f"{prefix}dirw"]),
+        )
+
     def system_snapshots(self, sys_idx: int) -> List[NodeDump]:
         if not self._snapshots:
             raise ValueError(
                 "engine built with snapshots=False has no phase-D state"
             )
+        cachew, dirw = self._word_planes("snap_")
         return self._dump(
-            np.asarray(self.state["snap_cachew"]),
-            np.asarray(self.state["snap_dirw"]),
-            sys_idx,
-            dirs=self._split_planes("snap_dirs"),
+            cachew, dirw, sys_idx, dirs=self._split_planes("snap_dirs")
         )
 
     def system_final_dumps(self, sys_idx: int) -> List[NodeDump]:
+        cachew, dirw = self._word_planes()
         return self._dump(
-            np.asarray(self.state["cachew"]),
-            np.asarray(self.state["dirw"]),
-            sys_idx,
-            dirs=self._split_planes("dirs"),
+            cachew, dirw, sys_idx, dirs=self._split_planes("dirs")
         )
 
     # single-system aliases matching the other engines' interface

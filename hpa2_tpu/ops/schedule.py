@@ -53,12 +53,17 @@ class Schedule:
     is backfilled and some live block's occupancy falls below this
     fraction (1.0 = compact whenever it frees a block).  ``interval``:
     cycles per barrier for the XLA batch engine (the Pallas engines
-    barrier at trace-window boundaries instead).
+    barrier at trace-window boundaries instead).  ``fused``: drive the
+    whole scheduled run as ONE device program from a precomputed
+    :class:`SchedulePlan` (compaction/backfill applied on-device at
+    the barriers); ``fused=False`` keeps the PR-5 host-barrier loop,
+    which relaunches one device program per interval.
     """
 
     resident: Optional[int] = None
     threshold: float = 0.5
     interval: int = 256
+    fused: bool = True
 
 
 @dataclasses.dataclass
@@ -75,6 +80,13 @@ class OccupancyStats:
     lane_intervals: int = 0
     compactions: int = 0
     admissions: int = 0
+    #: host round-trips the run pays for scheduling: one per interval
+    #: on the PR-5 host-barrier path, zero when the plan is fused into
+    #: the device program
+    host_barriers: int = 0
+    #: separately launched device programs per run: ``intervals`` on
+    #: the host-barrier path, exactly 1 when fused
+    device_programs: int = 0
 
     @property
     def mean_live_fraction(self) -> float:
@@ -98,7 +110,18 @@ class OccupancyStats:
             "speedup": round(self.speedup, 3),
             "compactions": self.compactions,
             "admissions": self.admissions,
+            "host_barriers": self.host_barriers,
+            "device_programs": self.device_programs,
         }
+
+    def set_mode(self, fused: bool) -> "OccupancyStats":
+        """Fill the execution-shape counters for a run mode: the fused
+        path compiles the whole plan into ONE device program with zero
+        host barriers; the host-barrier path launches (and syncs) once
+        per interval."""
+        self.host_barriers = 0 if fused else self.intervals
+        self.device_programs = 1 if fused else self.intervals
+        return self
 
 
 @dataclasses.dataclass
@@ -294,11 +317,14 @@ def simulate(
     block: int = 1,
     groups: int = 1,
     threshold: float = 0.5,
+    fused: bool = True,
 ) -> OccupancyStats:
     """The static occupancy model: replay the scheduling policy from a
     per-system segment-count vector alone.  Because the engines drive
     the *same* ``LaneScheduler``, the returned ``block_segments``
-    equals a real scheduled run's counter exactly."""
+    equals a real scheduled run's counter exactly.  ``fused`` selects
+    which execution shape the ``host_barriers``/``device_programs``
+    counters describe (the policy itself is mode-invariant)."""
     sched = LaneScheduler(
         nseg, resident=resident, block=block, groups=groups,
         threshold=threshold,
@@ -306,7 +332,88 @@ def simulate(
     while not sched.done():
         sched.begin_interval()
         sched.end_interval()
-    return sched.stats
+    return sched.stats.set_mode(fused)
+
+
+@dataclasses.dataclass
+class SchedulePlan:
+    """The whole scheduled run, precomputed: one row per interval of
+    the exact ``LaneScheduler`` replay, in the form the fused device
+    program consumes.
+
+    Row ``i`` describes interval ``i``: ``sys[i, l]`` is the system
+    resident in lane ``l`` (-1 = dead lane), ``seg[i, l]`` its
+    trace-window segment index, and the barrier to apply BEFORE the
+    interval runs is ``state[l] <- reset[i, l] ? init : state[perm[i,
+    l]]`` — exactly the PR-5 host barrier transform
+    (``pallas_engine._barrier_fn``), so the fused path is bit-exact by
+    construction.  Row 0's barrier is the identity.  Harvest needs no
+    plan: a system's state only changes while it is resident, so
+    scattering every live lane to its system's store column after
+    every interval leaves each column holding the harvest-time value.
+    """
+
+    sys: np.ndarray    # [n_int, R] int32, -1 = dead lane
+    seg: np.ndarray    # [n_int, R] int32
+    perm: np.ndarray   # [n_int, R] int32 gather indices
+    reset: np.ndarray  # [n_int, R] int32 0/1
+    stats: OccupancyStats
+
+    @property
+    def n_intervals(self) -> int:
+        return self.sys.shape[0]
+
+    @property
+    def resident(self) -> int:
+        return self.sys.shape[1]
+
+
+def build_plan(
+    nseg: np.ndarray,
+    *,
+    resident: Optional[int] = None,
+    block: int = 1,
+    groups: int = 1,
+    threshold: float = 0.5,
+) -> SchedulePlan:
+    """Replay the scheduling policy once, up-front, into the dense
+    per-interval arrays the fused run program scans over."""
+    sched = LaneScheduler(
+        nseg, resident=resident, block=block, groups=groups,
+        threshold=threshold,
+    )
+    r = sched.r
+    ident = np.arange(r, dtype=np.int32)
+    sys_rows, seg_rows, perm_rows, reset_rows = [], [], [], []
+    next_perm = ident
+    next_reset = np.zeros(r, dtype=np.int32)
+    while not sched.done():
+        sched.begin_interval()
+        sys_rows.append(sched.lane_sys.astype(np.int32))
+        seg_rows.append(sched.lane_seg.astype(np.int32))
+        perm_rows.append(next_perm)
+        reset_rows.append(next_reset)
+        plan = sched.end_interval()
+        next_perm = (
+            ident if plan.perm is None
+            else plan.perm.astype(np.int32)
+        )
+        next_reset = np.zeros(r, dtype=np.int32)
+        for lane, _s in plan.admitted:
+            next_reset[lane] = 1
+    # the final barrier is harvest-only (nothing left to admit or
+    # compact), and harvest is implicit in the per-interval scatter
+    return SchedulePlan(
+        sys=np.stack(sys_rows) if sys_rows else np.zeros(
+            (0, r), np.int32),
+        seg=np.stack(seg_rows) if seg_rows else np.zeros(
+            (0, r), np.int32),
+        perm=np.stack(perm_rows) if perm_rows else np.zeros(
+            (0, r), np.int32),
+        reset=np.stack(reset_rows) if reset_rows else np.zeros(
+            (0, r), np.int32),
+        stats=sched.stats.set_mode(fused=True),
+    )
 
 
 def segments_needed(tr_len: np.ndarray, window: int) -> np.ndarray:

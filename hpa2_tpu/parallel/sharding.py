@@ -371,6 +371,7 @@ def build_data_sharded_pallas_run(
     stream: bool = True,
     ablate: frozenset = frozenset(),
     gate: bool = True,
+    packed: bool = False,
 ):
     """The whole-run Pallas program of ``pallas_engine._build_stream_run``
     (or the legacy ``_build_run``) built at the per-shard lane count and
@@ -384,9 +385,9 @@ def build_data_sharded_pallas_run(
     build = pe._build_stream_run if stream else pe._build_run
     per_shard = build(
         config, shard_b, bb, k, interpret, snapshots, window, n_seg,
-        max_calls, ablate, gate,
+        max_calls, ablate, gate, packed,
     )
-    shapes = pe.state_shapes(config, snapshots)
+    shapes = pe.state_shapes(config, snapshots, packed)
     state_sp = {f: _lane_spec(len(sh) + 1) for f, sh in shapes.items()}
 
     def shard_body(state, tr, tr_len):
@@ -406,6 +407,71 @@ def build_data_sharded_pallas_run(
         # the run's ONLY cross-shard communication: OR-reduce the
         # per-shard stalled/overflow bits once, after every shard has
         # finished its independent quiescence loop
+        stalled = jnp.any((statuses & 1) != 0)
+        overflow = jnp.any((statuses & 2) != 0)
+        return state, (
+            stalled.astype(jnp.int32) | (overflow.astype(jnp.int32) << 1)
+        )
+
+    donate = () if interpret else (0,)
+    return jax.jit(run_all, donate_argnums=donate)
+
+
+@functools.lru_cache(maxsize=16)
+def build_fused_sharded_pallas_run(
+    config: SystemConfig,
+    r_shard: int,
+    bsys_shard: int,
+    bb: int,
+    k: int,
+    interpret: bool,
+    window: int,
+    nseg_max: int,
+    max_calls: int,
+    mesh: Mesh,
+    stream: bool = True,
+    ablate: frozenset = frozenset(),
+    gate: bool = True,
+    packed: bool = False,
+):
+    """The fused scheduled run (``pallas_engine._make_fused_run``)
+    built at per-shard lane/system counts and wrapped in
+    ``hostenv.shard_map``: each device scans the whole plan over ITS
+    contiguous lane group.  The scheduler's groups are shard-local
+    (block-diagonal permutations, group-local admission queues), so
+    the caller hands each shard its slice of the plan rows — localized
+    to the shard frame by ``DataShardedPallasEngine._fused_plan_arrays``
+    — and lanes never migrate across devices.  The sole cross-shard op
+    stays the final status OR-reduce."""
+    from hpa2_tpu.ops import pallas_engine as pe
+
+    per_shard = pe._make_fused_run(
+        config, r_shard, bsys_shard, bb, k, interpret, window, nseg_max,
+        max_calls, ablate, gate, stream, packed,
+    )
+    shapes = pe.state_shapes(config, snapshots=False, packed=packed)
+    state_sp = {f: _lane_spec(len(sh) + 1) for f, sh in shapes.items()}
+    plan_sp = P(None, "data")
+
+    def shard_body(state, tr, tr_len, sys, seg, perm, reset):
+        st, status = per_shard(state, tr, tr_len, sys, seg, perm, reset)
+        return st, status[None]  # one status lane per shard
+
+    wrapped = hostenv.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            state_sp, P(None, None, "data"), P(None, "data"),
+            plan_sp, plan_sp, plan_sp, plan_sp,
+        ),
+        out_specs=(state_sp, P("data")),
+        check_replication=False,
+    )
+
+    def run_all(state, tr, tr_len, sys, seg, perm, reset):
+        state, statuses = wrapped(
+            state, tr, tr_len, sys, seg, perm, reset
+        )
         stalled = jnp.any((statuses & 1) != 0)
         overflow = jnp.any((statuses & 2) != 0)
         return state, (
@@ -497,6 +563,7 @@ class DataShardedPallasEngine(PallasEngine):
             self.config, self._shard_b, self.block, self.cycles_per_call,
             self._interpret, self._snapshots, self._window, self._n_seg,
             max_calls, self.mesh, self._stream, self._ablate, self._gate,
+            self._packed,
         )
 
     def _interval_runner(self, max_cycles: int):
@@ -505,7 +572,39 @@ class DataShardedPallasEngine(PallasEngine):
             self.config, self._resident // self.data_shards, self.block,
             self.cycles_per_call, self._interpret, False, self._window,
             1, max_calls, self.mesh, self._stream, self._ablate,
-            self._gate,
+            self._gate, self._packed,
+        )
+
+    def _fused_runner(self, max_cycles: int):
+        max_calls = max(1, -(-max_cycles // self.cycles_per_call))
+        return build_fused_sharded_pallas_run(
+            self.config, self._resident // self.data_shards,
+            self._shard_b, self.block, self.cycles_per_call,
+            self._interpret, self._window, self._n_seg, max_calls,
+            self.mesh, self._stream, self._ablate, self._gate,
+            self._packed,
+        )
+
+    def _fused_plan_arrays(self, plan):
+        # Rebase the plan rows into each shard's local frame.  Groups
+        # are shard-local (one per device, `_sched_groups = shards`),
+        # so lane l belongs to group g = l // gl and its system /
+        # permutation indices all live inside that group's slice:
+        # system ids in [g*gs, (g+1)*gs), permutation targets in
+        # [g*gl, (g+1)*gl) (block-diagonal by construction).  The
+        # P(None, "data") sharding then hands shard g exactly its
+        # contiguous gl columns, already 0-based.
+        shards = self.data_shards
+        gl = self._resident // shards
+        gs = self.b // shards
+        g = np.arange(self._resident, dtype=np.int64) // gl
+        sys_l = np.where(plan.sys >= 0, plan.sys - g[None, :] * gs, -1)
+        perm_l = plan.perm - g[None, :] * gl
+        return (
+            jnp.asarray(sys_l.astype(np.int32)),
+            jnp.asarray(plan.seg),
+            jnp.asarray(perm_l.astype(np.int32)),
+            jnp.asarray(plan.reset),
         )
 
     def _sched_put(self, x):

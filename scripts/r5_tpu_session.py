@@ -30,7 +30,12 @@ Steps, in value order:
                      max/median trace-length spread): scheduled vs
                      unscheduled wall-clock + block-segment counters,
                      with a per-system scalars bit-exactness check
- 12. multichip     — the data_shards scaling ladder + bit-exactness
+ 12. fused_occupancy512 — the fused single-program scheduler (packed
+                     planes on) vs the PR-5 host-barrier path vs
+                     unscheduled on the shipped shape: how many real
+                     seconds the removed host barriers buy, with
+                     scalars bit-exactness gating both scheduled runs
+ 13. multichip     — the data_shards scaling ladder + bit-exactness
                      check (scripts/scale_runs.py multichip), which
                      writes MULTICHIP_r06.json with indicative:true
                      pod-slice numbers
@@ -199,11 +204,13 @@ def measure_occupancy_child(params) -> int:
 
     # warm BOTH programs: the unscheduled multi-segment run and the
     # scheduler's n_seg=1 interval program are different lru-cache
-    # entries, so each timed run needs its own compile out of the way
+    # entries, so each timed run needs its own compile out of the way.
+    # fused=False pins this step to the host-barrier (PR-5) path it
+    # has always measured; the fused path has its own three-way step.
     timed(None)
-    timed(Schedule())
+    timed(Schedule(fused=False))
     ref, ref_dt = timed(None)
-    eng, dt = timed(Schedule())
+    eng, dt = timed(Schedule(fused=False))
     exact = bool(np.array_equal(np.asarray(eng.state["scalars"]),
                                 np.asarray(ref.state["scalars"])))
     print(json.dumps({
@@ -214,6 +221,64 @@ def measure_occupancy_child(params) -> int:
         "occupancy": eng.occupancy.as_dict(), "bit_exact": exact,
     }))
     return 0 if exact else 1
+
+
+def measure_fused_occupancy_child(params) -> int:
+    """--measure-fused-occupancy mode: heterogeneous (zipf) ensemble,
+    three runs — unscheduled, PR-5 host-barrier scheduled, and fused
+    single-program scheduled (optionally with packed state planes) —
+    wall-clock + occupancy counters, one JSON line out.  Nonzero exit
+    iff either scheduled run's per-system scalars plane differs from
+    the unscheduled reference."""
+    import numpy as np
+
+    from hpa2_tpu.config import Semantics, SystemConfig
+    from hpa2_tpu.ops.pallas_engine import PallasEngine
+    from hpa2_tpu.ops.schedule import Schedule
+    from hpa2_tpu.utils.trace import gen_heterogeneous_random_arrays
+
+    batch, instrs, block, k, cap, window, gate, spread = params[:8]
+    packed = bool(params[8]) if len(params) > 8 else False
+    config = SystemConfig(num_procs=8, msg_buffer_size=cap,
+                          semantics=Semantics().robust())
+    arrays = gen_heterogeneous_random_arrays(
+        config, batch, instrs, dist="zipf", spread=float(spread),
+        seed=0)
+    kw = dict(block=block, cycles_per_call=k, snapshots=False,
+              trace_window=window, gate=bool(gate), packed=packed)
+
+    def timed(schedule):
+        eng = PallasEngine(config, *arrays, schedule=schedule, **kw)
+        t0 = time.perf_counter()
+        eng.run(max_cycles=5_000_000)
+        return eng, time.perf_counter() - t0
+
+    # three distinct programs, three compiles: warm each before timing
+    for sched in (None, Schedule(fused=False), Schedule()):
+        timed(sched)
+    ref, ref_dt = timed(None)
+    pr5, pr5_dt = timed(Schedule(fused=False))
+    fus, fus_dt = timed(Schedule())
+    scal = np.asarray(ref.state["scalars"])
+    exact5 = bool(np.array_equal(np.asarray(pr5.state["scalars"]),
+                                 scal))
+    exactf = bool(np.array_equal(np.asarray(fus.state["scalars"]),
+                                 scal))
+    print(json.dumps({
+        "batch": batch, "instrs": instrs, "block": block, "k": k,
+        "cap": cap, "window": window, "gate": gate, "spread": spread,
+        "packed": packed,
+        "unscheduled_s": round(ref_dt, 3),
+        "pr5_s": round(pr5_dt, 3), "fused_s": round(fus_dt, 3),
+        "fused_speedup_vs_unscheduled":
+            round(ref_dt / fus_dt, 2) if fus_dt else None,
+        "fused_speedup_vs_pr5":
+            round(pr5_dt / fus_dt, 2) if fus_dt else None,
+        "pr5_occupancy": pr5.occupancy.as_dict(),
+        "fused_occupancy": fus.occupancy.as_dict(),
+        "bit_exact_pr5": exact5, "bit_exact_fused": exactf,
+    }))
+    return 0 if exact5 and exactf else 1
 
 
 def measure(step, batch, instrs, block, k, cap, window, gate,
@@ -298,6 +363,10 @@ def main() -> int:
     if sys.argv[1:2] == ["--measure-occupancy"]:
         return measure_occupancy_child(
             [int(x) for x in sys.argv[2:10]]
+        )
+    if sys.argv[1:2] == ["--measure-fused-occupancy"]:
+        return measure_fused_occupancy_child(
+            [int(x) for x in sys.argv[2:11]]
         )
     session_start = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     skip = set()
@@ -414,6 +483,17 @@ def main() -> int:
             [os.path.abspath(__file__), "--measure-occupancy",
              "32768", "128", "512", "128", "16", "32", "1", "8"],
             timeout_s=1800, argv=True))
+
+    if "fused_occupancy512" not in skip and gate("fused_occupancy512"):
+        # the ISSUE-6 read: fused single-program scheduler (packed
+        # planes on) vs the PR-5 host-barrier path vs unscheduled on
+        # the shipped shape — how many real seconds removing the
+        # n_intervals host barriers (and halving the VMEM rent) buys
+        note(run_py(
+            "fused_occupancy512",
+            [os.path.abspath(__file__), "--measure-fused-occupancy",
+             "32768", "128", "512", "128", "16", "32", "1", "8", "1"],
+            timeout_s=2400, argv=True))
 
     if "multichip" not in skip and gate("multichip"):
         # full data_shards ladder + bit-exactness gate; rewrites

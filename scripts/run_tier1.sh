@@ -23,6 +23,35 @@ if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
   echo "TIER1: analysis occupancy smoke failed" >&2
   exit 1
 fi
+# Packed+fused smoke (~30s, CPU interpret): the ISSUE-6 fast path —
+# uint8/uint16 packed planes under the fused single-program scheduler
+# — must stay bit-exact against the unscheduled int32 reference, and
+# report exactly one device program.  Catches packed/fused wiring
+# breaks before the pytest budget is spent.
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python - > /dev/null <<'EOF'
+import numpy as np
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.ops.pallas_engine import PallasEngine
+from hpa2_tpu.ops.schedule import Schedule
+from hpa2_tpu.utils.trace import gen_heterogeneous_random_arrays
+
+cfg = SystemConfig(num_procs=4, semantics=Semantics().robust())
+kw = dict(block=4, cycles_per_call=32, snapshots=False, trace_window=8,
+          gate=True)
+arrays = gen_heterogeneous_random_arrays(cfg, 16, 24, dist="zipf",
+                                         spread=4.0, seed=1)
+ref = PallasEngine(cfg, *arrays, **kw).run()
+eng = PallasEngine(cfg, *arrays, packed=True,
+                   schedule=Schedule(resident=8), **kw).run()
+assert eng.occupancy.device_programs == 1
+assert eng.occupancy.host_barriers == 0
+assert all(eng.system_final_dumps(s) == ref.system_final_dumps(s)
+           for s in range(16))
+EOF
+then
+  echo "TIER1: packed+fused smoke failed" >&2
+  exit 1
+fi
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log

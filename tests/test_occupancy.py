@@ -143,7 +143,7 @@ def test_interval_is_the_unscheduled_program(cfg, small_zipf):
     assert eng._interval_runner(max_cycles) is _build_stream_run(
         cfg, eng._resident, eng.block, eng.cycles_per_call,
         eng._interpret, False, eng._window, 1, max_calls, frozenset(),
-        True,
+        True, False,
     )
     # the barrier transform is a different function entirely
     assert eng._barrier_fn() is not eng._interval_runner(max_cycles)
@@ -195,6 +195,26 @@ def test_zipf_scheduled_2x_fewer_block_segments_bit_exact(cfg):
 
     pred = predicted_stats(lens, _ZIPF_KW["trace_window"], eng.block)
     assert pred.block_segments == occ.block_segments
+
+    # the fused path (the default above) must match the PR-5
+    # host-barrier loop bit-for-bit at the 8x-zipf geometry, differing
+    # only in launch accounting: one device program instead of one
+    # per interval
+    eng5 = PallasEngine(
+        cfg, *arrays, schedule=Schedule(fused=False), **_ZIPF_KW
+    ).run()
+    assert np.array_equal(
+        np.asarray(eng.state["scalars"]),
+        np.asarray(eng5.state["scalars"]),
+    )
+    d, d5 = occ.as_dict(), eng5.occupancy.as_dict()
+    assert d["host_barriers"] == 0 and d["device_programs"] == 1
+    assert d5["host_barriers"] == d5["intervals"] > 1
+    assert d5["device_programs"] == d5["intervals"]
+    strip = ("host_barriers", "device_programs")
+    assert {k: v for k, v in d.items() if k not in strip} == (
+        {k: v for k, v in d5.items() if k not in strip}
+    )
 
 
 def test_streaming_resident_bit_exact(cfg, small_zipf):
@@ -250,6 +270,15 @@ def test_batchjax_scheduled_with_faults_bit_exact(cfg):
         ref.stats()["fault_retransmissions"]
     )
     assert eng.stats()["fault_retransmissions"] > 0
+    # crossed with the PR-5 host loop: the fused scan (the default
+    # above) preserves fault streams bit-for-bit too
+    eng5 = BatchJaxEngine(
+        fcfg, batch,
+        schedule=Schedule(resident=4, interval=64, fused=False),
+    ).run()
+    assert _dumps_match(eng, eng5, 12)
+    assert eng.stats() == eng5.stats()
+    assert eng5.occupancy.host_barriers == eng5.occupancy.intervals > 0
 
 
 @pytest.mark.virtual_mesh
@@ -268,6 +297,146 @@ def test_batchjax_scheduled_data_sharded_bit_exact(cfg):
         schedule=Schedule(resident=4, interval=64),
     ).run()
     assert _dumps_match(eng, ref, 12)
+
+
+# -- the fused scheduled path (ISSUE 6 tentpole) ---------------------------
+
+
+def test_fused_vs_host_barrier_full_state_bit_exact(cfg, small_zipf):
+    """The fused single-program run vs the PR-5 host-barrier loop at
+    resident < batch: every carried state plane (incl. the Pallas
+    scalars plane), every dump, and every occupancy counter except the
+    launch accounting must be bit-identical."""
+    arrays, ref = small_zipf
+    eng = PallasEngine(
+        cfg, *arrays, schedule=Schedule(resident=8), **_KW
+    ).run()
+    eng5 = PallasEngine(
+        cfg, *arrays, schedule=Schedule(resident=8, fused=False), **_KW
+    ).run()
+    assert _dumps_match(eng, ref, 24)
+    for f in eng.state:
+        assert np.array_equal(
+            np.asarray(eng.state[f]), np.asarray(eng5.state[f])
+        ), f
+    d, d5 = eng.occupancy.as_dict(), eng5.occupancy.as_dict()
+    assert d["host_barriers"] == 0 and d["device_programs"] == 1
+    assert d5["host_barriers"] == d5["intervals"] > 0
+    strip = ("host_barriers", "device_programs")
+    assert {k: v for k, v in d.items() if k not in strip} == (
+        {k: v for k, v in d5.items() if k not in strip}
+    )
+
+
+def test_fused_single_device_program_jaxpr_guard(cfg, small_zipf):
+    """The single-program pin: the fused runner's jaxpr holds exactly
+    as many pallas_call kernels as ONE interval program (the scan body
+    is traced once — no per-interval relaunch or duplication), and
+    each kernel's op count equals the unscheduled program's kernel
+    bit-for-bit (compaction/backfill confined to the barrier steps
+    between scan iterations, outside the cycle loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hpa2_tpu.ops import pallas_engine as pe
+    from hpa2_tpu.ops.schedule import build_plan
+    from test_vmem_budget import _count_eqns, _find_subjaxprs
+
+    arrays, _ = small_zipf
+    eng = PallasEngine(
+        cfg, *arrays, schedule=Schedule(resident=8), **_KW
+    )
+    max_cycles = 10_000
+    max_calls = max(1, -(-max_cycles // eng.cycles_per_call))
+    plan = build_plan(
+        eng._nseg, resident=eng._resident, block=eng.block, groups=1,
+        threshold=eng.schedule.threshold,
+    )
+    assert plan.stats.intervals > 1  # a real multi-interval plan
+    state = {
+        f: jnp.asarray(v)
+        for f, v in pe._init_state(
+            cfg, eng._resident, snapshots=False
+        ).items()
+    }
+    jx = jax.make_jaxpr(eng._fused_runner(max_cycles))(
+        state, eng._tr_full, eng._tr_len_full,
+        *eng._fused_plan_arrays(plan),
+    )
+    raw = pe._make_stream_run(
+        cfg, eng._resident, eng.block, eng.cycles_per_call,
+        eng._interpret, False, eng._window, 1, max_calls, frozenset(),
+        True, False,
+    )
+    jxu = jax.make_jaxpr(raw)(
+        state,
+        jnp.zeros((cfg.num_procs, eng._window, eng._resident),
+                  jnp.int32),
+        jnp.zeros((cfg.num_procs, eng._resident), jnp.int32),
+    )
+    kf = _find_subjaxprs(jx.jaxpr, "pallas_call")
+    ku = _find_subjaxprs(jxu.jaxpr, "pallas_call")
+    assert len(ku) >= 1
+    assert len(kf) == len(ku), (
+        f"fused program holds {len(kf)} kernels vs {len(ku)} in one "
+        f"interval — the scan body must be traced once, not per "
+        f"interval"
+    )
+    assert [_count_eqns(k) for k in kf] == [_count_eqns(k) for k in ku]
+
+
+@pytest.mark.virtual_mesh
+def test_fused_data_sharded_vs_host_barrier_bit_exact(cfg, small_zipf):
+    """Fused composes with data_shards=2 via shard-local plans (lanes
+    never migrate across devices): state planes bit-identical to the
+    PR-5 sharded loop, dumps bit-identical to the unsharded
+    unscheduled reference."""
+    _require_devices(2)
+    from hpa2_tpu.parallel.sharding import DataShardedPallasEngine
+
+    arrays, ref = small_zipf
+    eng = DataShardedPallasEngine(
+        cfg, *arrays, data_shards=2, schedule=Schedule(), **_KW
+    ).run()
+    eng5 = DataShardedPallasEngine(
+        cfg, *arrays, data_shards=2, schedule=Schedule(fused=False),
+        **_KW
+    ).run()
+    assert _dumps_match(eng, ref, 24)
+    for f in eng.state:
+        assert np.array_equal(
+            np.asarray(eng.state[f]), np.asarray(eng5.state[f])
+        ), f
+    assert eng.occupancy.device_programs == 1
+    assert eng.occupancy.host_barriers == 0
+    assert eng5.occupancy.host_barriers == eng5.occupancy.intervals > 0
+
+
+def test_fused_batchjax_vs_host_barrier_bit_exact(cfg):
+    """The XLA ensemble mirror: one lax.scan over admission waves vs
+    the PR-5 chunk-barrier host loop — dumps and stats bit-exact, and
+    the fused replay model fills the occupancy counters the host loop
+    measured."""
+    lens = heterogeneous_lengths(12, 24, dist="zipf", spread=4.0, seed=3)
+    batch = [
+        gen_uniform_random(cfg, int(n), seed=100 + s)
+        for s, n in enumerate(lens)
+    ]
+    from hpa2_tpu.ops.engine import BatchJaxEngine
+
+    eng = BatchJaxEngine(
+        cfg, batch, schedule=Schedule(resident=4, interval=64)
+    ).run()
+    eng5 = BatchJaxEngine(
+        cfg, batch, schedule=Schedule(resident=4, interval=64,
+                                      fused=False)
+    ).run()
+    assert _dumps_match(eng, eng5, 12)
+    assert eng.stats() == eng5.stats()
+    assert eng.occupancy.device_programs == 1
+    assert eng.occupancy.host_barriers == 0
+    assert eng5.occupancy.host_barriers == eng5.occupancy.intervals > 0
+    assert eng.occupancy.admissions == eng5.occupancy.admissions
 
 
 # -- lane-permutation invariance (the property scheduling relies on) -------
@@ -325,3 +494,26 @@ def test_occupancy_cli_table():
     table, rc = occupancy_table(32, 48, 8, 8, spreads=(4.0, 8.0))
     assert rc == 0
     assert "lockstep" in table and "zipf" in table
+    assert "barrier" in table and "progrm" in table
+    # fused launch accounting: 0 barriers / 1 program on every row
+    for row in table.splitlines()[2:]:
+        assert row.split()[-2:] == ["0", "1"]
+    # the PR-5 host loop pays one of each per interval
+    t5, rc5 = occupancy_table(32, 48, 8, 8, spreads=(4.0,), fused=False)
+    assert rc5 == 0
+    barrier, program = t5.splitlines()[2].split()[-2:]
+    assert barrier == program and int(barrier) > 1
+
+
+def test_predicted_stats_launch_accounting():
+    """Satellite pin: the model reports exactly 1 device program on
+    the fused path where the PR-5 path reports n_intervals."""
+    from hpa2_tpu.analysis.occupancy import predicted_stats
+
+    lens = heterogeneous_lengths(16, 32, dist="zipf", spread=4.0, seed=0)
+    fused = predicted_stats(lens, 8, 4, resident=8)
+    host = predicted_stats(lens, 8, 4, resident=8, fused=False)
+    assert fused.intervals == host.intervals > 1
+    assert fused.host_barriers == 0 and fused.device_programs == 1
+    assert host.host_barriers == host.intervals
+    assert host.device_programs == host.intervals
