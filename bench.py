@@ -90,6 +90,17 @@ def _data_shards() -> int:
         return 1
 
 
+def _node_shards() -> int:
+    """Node-axis sharding degree (``--node-shards``): splits every
+    simulated system's node planes over that many devices, with
+    cross-shard delivery by the targeted ppermute exchange.  Composes
+    with ``--data-shards`` into a 2-D ``data x node`` mesh."""
+    try:
+        return max(1, int(os.environ.get("HPA2_BENCH_NODE_SHARDS", "1")))
+    except ValueError:
+        return 1
+
+
 def _packed() -> bool:
     """Packed-state-plane knob (``--packed``): run the Pallas engines
     with the uint8/uint16 split planes instead of int32 words."""
@@ -164,7 +175,7 @@ def compile_gate_main() -> int:
 
 
 def bench_pallas(config, batch, instrs_per_core, seed=0, data_shards=1,
-                 dist=None, spread=8.0, packed=False,
+                 node_shards=1, dist=None, spread=8.0, packed=False,
                  schedule_resident=0, fused=True):
     from hpa2_tpu.ops.pallas_engine import PallasEngine
     from hpa2_tpu.utils.trace import (gen_heterogeneous_random_arrays,
@@ -207,7 +218,16 @@ def bench_pallas(config, batch, instrs_per_core, seed=0, data_shards=1,
     extra = dict(packed=packed)
     if schedule is not None:
         extra["schedule"] = schedule
-    if data_shards > 1:
+    if node_shards > 1:
+        from hpa2_tpu.parallel.sharding import NodeShardedPallasEngine
+
+        def build():
+            return NodeShardedPallasEngine(
+                config, *arrays, node_shards=node_shards,
+                data_shards=data_shards, block=block,
+                cycles_per_call=k, snapshots=False,
+                trace_window=window, gate=gate, **extra)
+    elif data_shards > 1:
         from hpa2_tpu.parallel.sharding import DataShardedPallasEngine
 
         def build():
@@ -227,6 +247,18 @@ def bench_pallas(config, batch, instrs_per_core, seed=0, data_shards=1,
     t0 = time.perf_counter()
     eng.run()
     dt = time.perf_counter() - t0
+    exchange = None
+    if node_shards > 1:
+        xmsgs = eng.cross_shard_msgs
+        cycles = max(eng.cycle, 1)
+        exchange = {
+            "node_shards": node_shards,
+            "ppermutes_per_cycle": 2 * (node_shards - 1),
+            "exchange_slots": 5 * (config.num_procs // node_shards),
+            "cross_shard_msgs": xmsgs,
+            "cross_shard_msgs_per_cycle": round(xmsgs / cycles, 2),
+            "msgs_total": eng.messages,
+        }
     if schedule is not None:
         # a scheduled run reports ITS occupancy counters — on the
         # fused path they flow from the plan/replay model (the host
@@ -235,7 +267,7 @@ def bench_pallas(config, batch, instrs_per_core, seed=0, data_shards=1,
         # bit-identical either way, only the launch accounting
         # (host_barriers/device_programs) differs
         occupancy = eng.occupancy.as_dict()
-    return eng.instructions, dt, occupancy
+    return eng.instructions, dt, occupancy, exchange
 
 
 def bench_jax(config, batch, instrs_per_core, seed=0):
@@ -283,6 +315,7 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
     config = _bench_config()
     on_tpu = platform == "tpu"
     shards = _data_shards()
+    node_shards = _node_shards()
     if on_tpu:
         batch, instrs_per_core = _TPU_BATCH, _TPU_INSTRS  # 33.5M instrs
     else:  # CPU smoke (pallas runs interpreted): keep it tiny
@@ -297,12 +330,13 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
     err = pallas_error
     ran_ok = False
     occupancy = None
+    exchange = None
     if pallas_ok or not on_tpu:  # CPU always tries interpret mode
         try:
-            jax_instrs, jax_dt, occupancy = bench_pallas(
+            jax_instrs, jax_dt, occupancy, exchange = bench_pallas(
                 config, batch, instrs_per_core, data_shards=shards,
-                dist=dist, spread=spread, packed=packed,
-                schedule_resident=resident, fused=fused)
+                node_shards=node_shards, dist=dist, spread=spread,
+                packed=packed, schedule_resident=resident, fused=fused)
             ran_ok = True
         except Exception as e:  # noqa: BLE001
             err = str(e)[-300:]
@@ -348,6 +382,23 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
         result["n_devices"] = len(jax.devices())
         if engine != "pallas":
             result["data_shards_note"] = "xla fallback ran unsharded"
+    if node_shards != 1:
+        import jax
+
+        result["node_shards"] = node_shards
+        result["n_devices"] = len(jax.devices())
+        if exchange is not None:
+            result["cross_shard_msgs_per_cycle"] = exchange[
+                "cross_shard_msgs_per_cycle"]
+            result["exchange"] = exchange
+            print(
+                f"[pallas] cross-shard msgs: "
+                f"{exchange['cross_shard_msgs']} "
+                f"({exchange['cross_shard_msgs_per_cycle']}/cycle)",
+                file=sys.stderr,
+            )
+        if engine != "pallas":
+            result["node_shards_note"] = "xla fallback ran unsharded"
     if engine != "pallas":
         result["pallas_error"] = err
     else:
@@ -519,7 +570,8 @@ def _run_child(platform: str, timeout_s: int, pallas_ok: bool,
     """Run the measurement child; returns the parsed JSON dict or None."""
     try:
         hostenv = _hostenv()
-        shards = _data_shards()
+        # the (data, node) mesh needs data_shards * node_shards devices
+        shards = _data_shards() * _node_shards()
         env = (
             hostenv.cache_env(dict(os.environ))
             if platform == "tpu"
@@ -572,6 +624,18 @@ def main() -> int:
             )
         except (IndexError, ValueError):
             print("usage: bench.py [--data-shards N]", file=sys.stderr)
+            return 2
+    if "--node-shards" in sys.argv:
+        # split each system's node planes over N devices
+        # (NodeShardedPallasEngine, targeted cross-shard exchange);
+        # composes with --data-shards into a 2-D data x node mesh
+        i = sys.argv.index("--node-shards")
+        try:
+            os.environ["HPA2_BENCH_NODE_SHARDS"] = str(
+                int(sys.argv[i + 1])
+            )
+        except (IndexError, ValueError):
+            print("usage: bench.py [--node-shards N]", file=sys.stderr)
             return 2
     if "--trace-len-dist" in sys.argv:
         # heterogeneous per-system trace lengths (uniform|zipf over

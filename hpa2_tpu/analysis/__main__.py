@@ -113,13 +113,22 @@ def cmd_vmem(args: argparse.Namespace) -> int:
         num_procs=args.procs, msg_buffer_size=args.cap,
         semantics=_SEMS[args.sem[0]](),
     )
+    if args.node_shards < 1 or args.procs % args.node_shards:
+        print(
+            f"--node-shards {args.node_shards} must divide --procs "
+            f"{args.procs} (shards own contiguous equal node blocks)",
+            file=sys.stderr,
+        )
+        return 2
     blocks = tuple(int(b) for b in args.blocks.split(","))
     print(budget_table(cfg, blocks, args.window,
                        snapshots=args.snapshots, gate=args.gate,
-                       packed=args.packed))
+                       packed=args.packed,
+                       node_shards=args.node_shards))
     worst = vmem_budget(cfg, max(blocks), args.window,
                         snapshots=args.snapshots, gate=args.gate,
-                        packed=args.packed)
+                        packed=args.packed,
+                        node_shards=args.node_shards)
     return 0 if worst.fits else 1
 
 
@@ -189,6 +198,10 @@ def main(argv=None) -> int:
     vp.add_argument("--gate", action="store_true")
     vp.add_argument("--packed", action="store_true",
                     help="model the packed uint8/uint16 state planes")
+    vp.add_argument("--node-shards", type=int, default=1,
+                    help="model one shard of the node-sharded engine "
+                         "(num_procs/node_shards local nodes per "
+                         "device; must divide --procs)")
     op = sub.add_parser("occupancy", help="occupancy scheduler model")
     op.add_argument("--batch", type=int, default=64)
     op.add_argument("--instrs", type=int, default=96,

@@ -48,6 +48,13 @@ BYTES_PER_ROW_PER_LANE = 4  # i32 planes (packed planes use itemsize)
 PIPELINE_COPIES = 2
 
 
+#: planes that are NOT node-leading (replicated enum-sized rows); every
+#: other plane's leading axis is the node/directory axis and shrinks to
+#: ``num_procs / node_shards`` rows per shard under node sharding
+#: (mirrors ``parallel.sharding``'s per-shard plane shapes)
+_REPLICATED_PLANES = ("scalars", "msg_counts")
+
+
 @dataclasses.dataclass(frozen=True)
 class VmemBudget:
     """Predicted structural VMEM footprint of one kernel block."""
@@ -59,6 +66,7 @@ class VmemBudget:
     gate: bool
     stream: bool
     packed: bool
+    node_shards: int
     rows: Dict[str, int]        # carried rows/lane per plane
     lane_bytes: Dict[str, int]  # dtype-aware bytes/lane per plane
     carried_rows: int           # sum over carried (non-snapshot) planes
@@ -84,12 +92,15 @@ class VmemBudget:
 
 
 def _plane_rows(config: SystemConfig, snapshots: bool,
-                packed: bool = False) -> Dict[str, int]:
+                packed: bool = False,
+                node_shards: int = 1) -> Dict[str, int]:
     from hpa2_tpu.ops.pallas_engine import state_shapes
 
     shapes = state_shapes(config, snapshots, packed)
     rows = {}
     for name, prefix in shapes.items():
+        if node_shards > 1 and name not in _REPLICATED_PLANES:
+            prefix = (prefix[0] // node_shards,) + tuple(prefix[1:])
         r = 1
         for d in prefix:
             r *= d
@@ -98,7 +109,8 @@ def _plane_rows(config: SystemConfig, snapshots: bool,
 
 
 def _plane_lane_bytes(config: SystemConfig, snapshots: bool,
-                      packed: bool = False) -> Dict[str, int]:
+                      packed: bool = False,
+                      node_shards: int = 1) -> Dict[str, int]:
     """Per-plane BYTES per lane: rows times the carried dtype width
     (all 4 for the legacy int32 layout; the packed cache/dir planes
     drop to 1-2)."""
@@ -106,7 +118,7 @@ def _plane_lane_bytes(config: SystemConfig, snapshots: bool,
 
     from hpa2_tpu.ops.pallas_engine import state_dtypes
 
-    rows = _plane_rows(config, snapshots, packed)
+    rows = _plane_rows(config, snapshots, packed, node_shards)
     dtypes = state_dtypes(config, snapshots, packed)
     return {f: r * np.dtype(dtypes[f]).itemsize for f, r in rows.items()}
 
@@ -138,11 +150,24 @@ def vmem_budget(
     gate: bool = False,
     stream: bool = True,
     packed: bool = False,
+    node_shards: int = 1,
 ) -> VmemBudget:
-    """Predict the per-block VMEM footprint of the run kernel."""
-    n = config.num_procs
-    rows = _plane_rows(config, snapshots, packed)
-    lane_bytes = _plane_lane_bytes(config, snapshots, packed)
+    """Predict the per-block VMEM footprint of the run kernel.
+
+    ``node_shards > 1`` models one device of the node-sharded engine:
+    every node-leading plane (and the trace window) carries only the
+    shard's ``num_procs / node_shards`` local rows, while the
+    replicated ``scalars``/``msg_counts`` rows stay whole — the same
+    per-shard geometry ``parallel.sharding`` places on the mesh.
+    """
+    if node_shards < 1 or config.num_procs % node_shards:
+        raise ValueError(
+            f"node_shards={node_shards} must divide "
+            f"num_procs={config.num_procs}"
+        )
+    n = config.num_procs // node_shards
+    rows = _plane_rows(config, snapshots, packed, node_shards)
+    lane_bytes = _plane_lane_bytes(config, snapshots, packed, node_shards)
     snap_rows = sum(r for f, r in rows.items() if f.startswith("snap_"))
     carried_rows = sum(
         r for f, r in rows.items() if not f.startswith("snap_")
@@ -182,7 +207,8 @@ def vmem_budget(
     total_b = operand_b + live_b + scratch_b
     return VmemBudget(
         config=config, block=block, window=window, snapshots=snapshots,
-        gate=gate, stream=stream, packed=packed, rows=rows,
+        gate=gate, stream=stream, packed=packed,
+        node_shards=node_shards, rows=rows,
         lane_bytes=lane_bytes, carried_rows=carried_rows,
         snap_rows=snap_rows, trace_rows=trace_rows, operand_rows=operand,
         live_rows=live, scratch_rows=scratch, total_rows=total,
@@ -202,14 +228,21 @@ def budget_table(
     snapshots: bool = False,
     gate: bool = False,
     packed: bool = False,
+    node_shards: int = 1,
 ) -> str:
     """The ``analysis vmem`` report: streamed vs legacy footprint per
-    block width against the 16 MiB cap."""
+    block width against the 16 MiB cap.  With ``node_shards > 1`` the
+    figures are per shard (``num_procs / node_shards`` local nodes)."""
+    n_local = config.num_procs // max(node_shards, 1)
     lines = [
         f"VMEM budget model  (n={config.num_procs} cap="
         f"{config.msg_buffer_size} window={window} "
-        f"snapshots={snapshots} gate={gate} packed={packed}; cap "
-        f"{_fmt_mb(VMEM_CAP_BYTES).strip()} MiB)",
+        f"snapshots={snapshots} gate={gate} packed={packed}"
+        + (
+            f" node_shards={node_shards} [{n_local} local nodes/shard]"
+            if node_shards > 1 else ""
+        )
+        + f"; cap {_fmt_mb(VMEM_CAP_BYTES).strip()} MiB)",
         f"{'block':>6} {'mode':>8} {'B/lane':>8} {'MiB':>7} "
         f"{'headroom':>9}  fits",
     ]
@@ -218,7 +251,7 @@ def budget_table(
             bud = vmem_budget(
                 config, block, window,
                 snapshots=snapshots, gate=gate, stream=stream,
-                packed=packed,
+                packed=packed, node_shards=node_shards,
             )
             lines.append(
                 f"{block:>6} {'stream' if stream else 'legacy':>8} "
@@ -226,7 +259,47 @@ def budget_table(
                 f"{_fmt_mb(bud.headroom_bytes)}  "
                 f"{'yes' if bud.fits else 'NO'}"
             )
+    if node_shards > 1:
+        m1 = max_fitting_block(
+            config, window, snapshots=snapshots, gate=gate,
+            packed=packed, node_shards=1,
+        )
+        ms = max_fitting_block(
+            config, window, snapshots=snapshots, gate=gate,
+            packed=packed, node_shards=node_shards,
+        )
+        lines.append(
+            f"max fitting block: {m1} (1 shard) -> {ms} "
+            f"({node_shards} shards)"
+        )
     return "\n".join(lines)
+
+
+def max_fitting_block(
+    config: SystemConfig,
+    window: int = 32,
+    *,
+    snapshots: bool = False,
+    gate: bool = False,
+    stream: bool = True,
+    packed: bool = False,
+    node_shards: int = 1,
+    limit: int = 1 << 20,
+) -> int:
+    """Largest power-of-two lane block the model predicts under the
+    VMEM cap — the block ladder's top rung.  Halving the node-leading
+    plane rows (node sharding) widens it: the per-shard working set
+    per lane shrinks, so more lanes fit the same 16 MiB."""
+    best = 0
+    block = 1
+    while block <= limit:
+        if vmem_budget(
+            config, block, window, snapshots=snapshots, gate=gate,
+            stream=stream, packed=packed, node_shards=node_shards,
+        ).fits:
+            best = block
+        block *= 2
+    return best
 
 
 def measured_vmem_bytes(compiled) -> Optional[int]:

@@ -96,11 +96,6 @@ def _build_config(args) -> SystemConfig:
             "backends (the pallas kernel and the native engine have "
             "no link-layer fault model)"
         )
-    if fault.enabled and getattr(args, "node_shards", 1) > 1:
-        raise SystemExit(
-            "fault injection is single-shard only (the link-layer "
-            "PRNG stream is per-system, not per-shard)"
-        )
     return SystemConfig(
         num_procs=args.nodes,
         cache_size=args.cache_size,
@@ -126,11 +121,18 @@ def _write_dumps(dumps, config, out_dir: str) -> List[str]:
 
 
 def _check_shard_args(args) -> None:
-    if (args.node_shards > 1 or args.data_shards > 1) and args.backend != "jax":
+    if (args.node_shards > 1 or args.data_shards > 1) and args.backend not in (
+        "jax", "pallas"
+    ):
         raise SystemExit(
-            "--node-shards/--data-shards are jax-backend features "
-            "(device-mesh sharding; the omp/spec/pallas backends are "
-            "single-host)"
+            "--node-shards/--data-shards are jax/pallas-backend "
+            "features (device-mesh sharding; the omp/spec backends "
+            "are single-host)"
+        )
+    if args.node_shards > 1 and args.nodes % args.node_shards != 0:
+        raise SystemExit(
+            f"--node-shards {args.node_shards} must divide --nodes "
+            f"{args.nodes} (shards own contiguous equal node blocks)"
         )
 
 
@@ -244,10 +246,24 @@ def cmd_run(args) -> int:
             from hpa2_tpu.ops.pallas_engine import PallasEngine
             from hpa2_tpu.utils.trace import traces_to_arrays
 
-            eng = PallasEngine(
-                config, *traces_to_arrays(config, [traces]),
-                snapshots=not args.final_dump,
-            )
+            if args.node_shards > 1:
+                # one system's node axis split over the mesh; delivery
+                # is the targeted cross-shard exchange, bit-identical
+                # to the single-chip kernel
+                from hpa2_tpu.parallel.sharding import (
+                    NodeShardedPallasEngine,
+                )
+
+                eng = NodeShardedPallasEngine(
+                    config, *traces_to_arrays(config, [traces]),
+                    node_shards=args.node_shards,
+                    snapshots=not args.final_dump,
+                )
+            else:
+                eng = PallasEngine(
+                    config, *traces_to_arrays(config, [traces]),
+                    snapshots=not args.final_dump,
+                )
             eng.run(args.max_cycles)
         elif args.node_shards > 1:
             # multi-chip: shard the simulated-node axis over the mesh
@@ -363,12 +379,33 @@ def cmd_bench(args) -> int:
                     for b in range(args.batch)
                 ],
             )
-        PallasEngine(config, *arrays).run(args.max_cycles)  # warmup
-        eng = PallasEngine(config, *arrays)
+        if args.node_shards > 1:
+            from hpa2_tpu.parallel.sharding import NodeShardedPallasEngine
+
+            mk = lambda: NodeShardedPallasEngine(
+                config, *arrays, node_shards=args.node_shards,
+                data_shards=args.data_shards,
+            )
+        elif args.data_shards > 1:
+            from hpa2_tpu.parallel.sharding import DataShardedPallasEngine
+
+            mk = lambda: DataShardedPallasEngine(
+                config, *arrays, data_shards=args.data_shards
+            )
+        else:
+            mk = lambda: PallasEngine(config, *arrays)
+        mk().run(args.max_cycles)  # warmup
+        eng = mk()
         t0 = time.perf_counter()
         eng.run(args.max_cycles)
         dt = time.perf_counter() - t0
         instrs = eng.instructions
+        if args.node_shards > 1 and eng.cycle:
+            print(
+                f"[pallas] cross-shard msgs: {eng.cross_shard_msgs} "
+                f"({eng.cross_shard_msgs / eng.cycle:.2f}/cycle)",
+                file=sys.stderr,
+            )
     elif args.node_shards > 1 or args.data_shards > 1:
         # multi-chip bench: node axis and/or ensemble axis sharded over
         # the device mesh (GridEngine = shard_map(vmap(step)))
@@ -550,14 +587,16 @@ def cmd_bench(args) -> int:
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--node-shards", type=int, default=1,
-        help="jax backend: shard the simulated-node axis over this "
-        "many devices (cross-shard mailbox delivery rides one ICI "
-        "all_gather per cycle; bit-identical to single-chip)",
+        help="jax/pallas backends: shard the simulated-node axis over "
+        "this many devices (cross-shard mailbox delivery is a targeted "
+        "ppermute exchange — ICI bytes scale with actual crossings, "
+        "not num_procs; bit-identical to single-chip)",
     )
     p.add_argument(
         "--data-shards", type=int, default=1,
-        help="jax bench with --batch > 1: shard the ensemble axis "
-        "over this many devices (the DP analog)",
+        help="jax/pallas bench with --batch > 1: shard the ensemble "
+        "axis over this many devices (the DP analog; composes with "
+        "--node-shards as a data x node mesh)",
     )
     p.add_argument("--nodes", type=int, default=4)
     p.add_argument("--cache-size", type=int, default=4)

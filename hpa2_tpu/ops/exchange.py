@@ -1,0 +1,199 @@
+"""Targeted cross-shard message exchange for node-axis sharding.
+
+The node-sharded engines partition the node/directory planes into
+contiguous blocks of ``n_local = num_procs // node_shards`` nodes per
+mesh shard.  Phase C (deterministic delivery) is the only point where
+nodes talk across the partition, and it used to be a full
+``all_gather`` of the candidate-message tensor — O(num_procs) ICI
+bytes per cycle regardless of how many messages actually cross.
+
+This module holds the shared machinery for the replacement, a
+*targeted* exchange (used by both ``ops/step.py`` and the XLA-level
+node-sharded cycle in ``ops/pallas_engine.py``):
+
+1. **Bucket by destination.** Every send candidate names its receivers
+   (point sends: ``recv``, so the owning shard is ``recv // n_local``;
+   INV multicasts: the sharer-mask bits that fall in a shard's node
+   range).  For each peer shard the sender builds a boolean dest mask
+   over its candidate axis.
+
+2. **Order-preserving compaction.** Candidates headed to one peer are
+   compacted into a fixed ``K``-entry buffer by an exclusive-cumsum
+   position (:func:`compact`), which preserves the global candidate
+   order *within* the buffer.  ``K`` defaults to the capacity-exact
+   bound (every local candidate could target one peer); a tighter
+   ``K`` trades ICI bytes for a loud overflow status — never a silent
+   drop, because the sender cannot know whether a dropped entry would
+   have been accepted.
+
+3. **Pairwise rounds.** Round ``r`` (1..D-1) ships each shard's buffer
+   to shard ``(i + r) % D`` with one ``ppermute`` (:func:`fwd_perm`);
+   the acceptance feedback returns along :func:`rev_perm`.  A cycle
+   therefore costs exactly ``2*(D-1)`` ppermutes plus ONE stacked psum
+   (counters + quiescence), pinned by the collective-count guards in
+   tests.
+
+4. **Ordered-rank acceptance.** The receiver sees one *local* block
+   plus ``D-1`` received buffers, each tagged with a traced origin
+   shard.  Delivery order must equal the single-chip engine's global
+   candidate order (all phase-A candidates ascending (origin, slot),
+   then all phase-B).  :func:`ordered_rank` computes each entry's rank
+   in that order without materializing it: per-block exclusive prefix
+   sums plus cross-block offsets gated on ``origin_b' < origin_b`` —
+   the received blocks can stay in arrival (round) order, which is
+   shard-dependent and therefore cannot be permuted statically.
+
+Everything here is plain XLA (collectives cannot run inside a Mosaic
+kernel), shared by the retrofitted ``build_step`` and the node-sharded
+cycle program.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+# rank sentinel for invalid entries: larger than any mailbox capacity
+# but far from i32 overflow when compared against count2 + rank
+RANK_INVALID = 1 << 30
+
+
+def fwd_perm(d: int, r: int) -> List[Tuple[int, int]]:
+    """Round-``r`` forward permutation: shard i sends to (i+r) % d."""
+    return [(i, (i + r) % d) for i in range(d)]
+
+
+def rev_perm(d: int, r: int) -> List[Tuple[int, int]]:
+    """Feedback permutation for round ``r``: shard i sends back to
+    (i-r) % d — the shard whose buffer it received in :func:`fwd_perm`."""
+    return [(i, (i - r) % d) for i in range(d)]
+
+
+def origin_of_round(me, d: int, r: int):
+    """The (traced) origin shard of the buffer received in round r."""
+    return (me - r) % d
+
+
+def _ones_below(k, bpw: int):
+    """uint32 mask of the low ``clip(k, 0, bpw)`` bits, for traced
+    ``k`` (sign-safe up to bpw == 32)."""
+    kk = jnp.clip(k, 0, bpw)
+    mask = (U32(1) << jnp.clip(kk, 0, 31).astype(U32)) - U32(1)
+    if bpw >= 32:
+        mask = jnp.where(kk >= 32, U32(0xFFFFFFFF), mask)
+    return mask
+
+
+def range_mask_words(lo, hi, nwords: int, bpw: int):
+    """Per-word uint32 masks selecting mask bits whose *global* node id
+    falls in [lo, hi): word ``w`` covers ids [w*bpw, w*bpw + bpw).
+    ``lo``/``hi`` may be traced (the peer shard id is)."""
+    return jnp.stack(
+        [
+            _ones_below(hi - w * bpw, bpw) & ~_ones_below(lo - w * bpw, bpw)
+            for w in range(nwords)
+        ]
+    )
+
+
+def compact(dest, payload, k: int):
+    """Order-preserving compaction along candidate axis.
+
+    ``dest``: [J, ...] bool/i32 destination mask; ``payload``:
+    [R, J, ...] entry rows.  Returns ``(buf [R, k, ...], sel
+    [J, k, ...] i32, overflow [...] i32)`` where ``sel`` is the
+    one-hot candidate->entry placement (reused to scatter the
+    acceptance feedback back onto candidates) and ``overflow`` counts
+    candidates that did not fit ``k`` entries (0 when ``k`` is the
+    capacity-exact bound)."""
+    db = dest if dest.dtype == jnp.bool_ else (dest != 0)
+    d = db.astype(I32)
+    pos = jnp.cumsum(d, axis=0) - d
+    tail = (1,) * (dest.ndim - 1)
+    iota_k = jnp.arange(k, dtype=I32).reshape((1, k) + tail)
+    sel = jnp.where(
+        db[:, None] & (pos[:, None] == iota_k), 1, 0
+    ).astype(I32)
+    buf = jnp.einsum("rj...,jk...->rk...", payload, sel)
+    overflow = jnp.sum(jnp.where(db & (pos >= k), 1, 0), axis=0)
+    return buf, sel, overflow
+
+
+def uncompact(fb, sel):
+    """Scatter per-entry feedback rows [R, k, ...] back onto the
+    candidate axis via the saved placement: -> [R, J, ...]."""
+    return jnp.einsum("rk...,jk...->rj...", fb, sel)
+
+
+def ordered_rank(
+    v_a,
+    v_b,
+    bounds: Sequence[int],
+    origins: Sequence,
+    axis: int = 1,
+):
+    """Global delivery rank per entry over origin-ordered blocks.
+
+    ``v_a``/``v_b``: i32/bool masks of valid phase-A / phase-B entries
+    over the concatenated entry axis ``axis`` (blocks are contiguous
+    slices ``bounds[b]:bounds[b+1]``, in arbitrary physical order).
+    ``origins``: one (possibly traced) shard id per block.  The global
+    candidate order is: all A entries ascending (origin, in-block
+    index), then all B entries likewise — which matches the single-chip
+    candidate grid because shards own contiguous node ranges and
+    compaction preserves in-block order.
+
+    Returns ``rank`` with the entry's 0-based position among valid
+    entries in that global order (``RANK_INVALID`` where neither mask
+    is set).  ``rank`` is the drop-in replacement for the single-chip
+    ``cumsum(valid) - valid`` prefix."""
+    va = v_a.astype(I32)
+    vb = v_b.astype(I32)
+    cum_a = jnp.cumsum(va, axis=axis)
+    cum_b = jnp.cumsum(vb, axis=axis)
+    nb = len(bounds) - 1
+
+    def at(c, idx):
+        return jax.lax.index_in_dim(c, idx, axis=axis, keepdims=True)
+
+    base_a, base_b, cnt_a, cnt_b = [], [], [], []
+    for b in range(nb):
+        s, e = bounds[b], bounds[b + 1]
+        ba = at(cum_a, s - 1) if s else jnp.zeros_like(at(cum_a, 0))
+        bb_ = at(cum_b, s - 1) if s else jnp.zeros_like(at(cum_b, 0))
+        base_a.append(ba)
+        base_b.append(bb_)
+        cnt_a.append(at(cum_a, e - 1) - ba)
+        cnt_b.append(at(cum_b, e - 1) - bb_)
+    total_a = at(cum_a, bounds[-1] - 1)
+
+    adj_a, adj_b = [], []
+    for b in range(nb):
+        off_a = -base_a[b]
+        off_b = -base_b[b]
+        for b2 in range(nb):
+            if b2 == b:
+                continue
+            earlier = origins[b2] < origins[b]
+            off_a = off_a + jnp.where(earlier, cnt_a[b2], 0)
+            off_b = off_b + jnp.where(earlier, cnt_b[b2], 0)
+        width = bounds[b + 1] - bounds[b]
+        shape = list(v_a.shape)
+        shape[axis] = width
+        adj_a.append(jnp.broadcast_to(off_a, shape))
+        adj_b.append(jnp.broadcast_to(off_b, shape))
+    adj_a = jnp.concatenate(adj_a, axis=axis)
+    adj_b = jnp.concatenate(adj_b, axis=axis)
+
+    rank_a = cum_a - va + adj_a
+    rank_b = cum_b - vb + adj_b + total_a
+    return jnp.where(
+        va != 0,
+        rank_a,
+        jnp.where(vb != 0, rank_b, RANK_INVALID),
+    )

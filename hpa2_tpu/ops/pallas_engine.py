@@ -91,6 +91,7 @@ import jax.numpy as jnp
 from hpa2_tpu.config import SystemConfig
 from hpa2_tpu.models.protocol import CacheState, DirState, MsgType
 from hpa2_tpu.models.spec_engine import StallError
+from hpa2_tpu.ops import exchange
 from hpa2_tpu.utils.dump import NodeDump
 
 I32 = jnp.int32
@@ -515,7 +516,9 @@ def _test_bit(mask, proc):
 
 
 def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
-                ablate: frozenset = frozenset(), packed: bool = False):
+                ablate: frozenset = frozenset(), packed: bool = False,
+                axis_name: Optional[str] = None, shards: int = 1,
+                exchange_slots: Optional[int] = None):
     """One lockstep cycle over a block of ``bb`` systems in transposed
     layout.  Pure jnp on a state dict — runs inside the Pallas kernel
     and, for validation, directly under jit/CPU.
@@ -525,6 +528,19 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
     cycle body itself is unchanged — packed planes are ``_widen``-ed
     into the legacy words at entry and re-``_narrow``-ed at exit, so a
     packed cycle is bit-exact with the unpacked one by construction.
+
+    ``axis_name``/``shards``: node-sharded SPMD mode.  The body sees
+    the local block of ``num_procs // shards`` node rows and phase C
+    runs the targeted cross-shard exchange (``ops/exchange.py``) —
+    2*(shards-1) ppermutes plus ONE stacked psum per cycle.  This mode
+    is plain XLA under ``shard_map`` (collectives cannot run inside a
+    Mosaic kernel) and carries three transient [1, bb] rows in the
+    state dict: ``activeg`` (psum'd global activity, the quiescence
+    signal), ``xmsgs`` (cumulative cross-shard messages) and
+    ``exchov`` (sticky exchange-overflow flag).  ``exchange_slots``
+    caps the per-peer buffer (default: the capacity-exact
+    ``5 * n_local``, which cannot overflow); a tighter cap trades ICI
+    bytes for a loud overflow status.
 
     ``ablate`` (perf tooling only, scripts/perf_sweep.py --ablate):
     named cycle stages are stubbed out to attribute per-cycle time on
@@ -542,6 +558,20 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             "messages_per_cycle > 1 runs on the spec engine"
         )
     nack = sem.intervention_miss_policy == "nack"
+    sharded = axis_name is not None and shards > 1
+    if sharded:
+        if n % shards != 0:
+            raise ValueError(
+                f"num_procs={n} not divisible by node shards={shards}"
+            )
+        if ablate:
+            raise ValueError("--ablate is single-node-shard only")
+    nl = n // shards if sharded else n
+    k_slots = 5 * nl if exchange_slots is None else int(exchange_slots)
+    if sharded and not (1 <= k_slots <= 5 * nl):
+        raise ValueError(
+            f"exchange_slots={exchange_slots} out of range [1, {5 * nl}]"
+        )
     layout, W = _mb_layout(config)
     recv_packed = "recv" in layout
     split = _split_mode(config)
@@ -562,10 +592,19 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         s = dict(s)
         # iotas are built inside the traced body (a pallas kernel may
         # not capture array constants from the closure)
-        iota_n = jax.lax.broadcasted_iota(I32, (n, bb), 0)
-        iota_c = jax.lax.broadcasted_iota(I32, (n, c, bb), 1)
-        iota_m = jax.lax.broadcasted_iota(I32, (n, m, bb), 1)
-        iota_cap = jax.lax.broadcasted_iota(I32, (n, cap, bb), 1)
+        iota_n = jax.lax.broadcasted_iota(I32, (nl, bb), 0)
+        iota_c = jax.lax.broadcasted_iota(I32, (nl, c, bb), 1)
+        iota_m = jax.lax.broadcasted_iota(I32, (nl, m, bb), 1)
+        iota_cap = jax.lax.broadcasted_iota(I32, (nl, cap, bb), 1)
+        # global node ids of the local rows (aliases iota_n when
+        # unsharded: zero extra ops, the jaxpr op-count guard holds)
+        if sharded:
+            gids = (
+                iota_n
+                + jax.lax.axis_index(axis_name).astype(I32) * nl
+            )
+        else:
+            gids = iota_n
 
         def read_c(arr, idx):  # [N,C,B] by [N,B] -> [N,B]
             return jnp.sum(
@@ -608,7 +647,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         heads = [s[f"mb{w}"][:, 0, :] for w in range(W)]    # [N, B]
         mt = jnp.where(has_msg, dec(heads, "type"), _NO_MSG)
         if "phase_a" in ablate:  # handlers fold to no-ops
-            mt = jnp.full((n, bb), _NO_MSG, I32)
+            mt = jnp.full((nl, bb), _NO_MSG, I32)
         snd = dec(heads, "sender")
         sr = dec(heads, "second") - 1
         a = dec(heads, "addr")
@@ -628,8 +667,8 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         home = a // m
         blk = a % m
         ci = a % c
-        is_home = iota_n == home
-        is_second = iota_n == sr
+        is_home = gids == home
+        is_second = gids == sr
 
         cw = read_c(s["cachew"], ci)
         line_state = cw & 3
@@ -640,9 +679,9 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         ds = (dw >> _DW_STATE_SHIFT) & 3
         pw = pw_in
 
-        zero = jnp.zeros((n, bb), dtype=I32)
-        false = jnp.zeros((n, bb), dtype=bool)
-        neg1_nb = jnp.full((n, bb), -1, I32)
+        zero = jnp.zeros((nl, bb), dtype=I32)
+        false = jnp.zeros((nl, bb), dtype=bool)
+        neg1_nb = jnp.full((nl, bb), -1, I32)
 
         # --- sharer sets as SW-word vectors (SW == 1 packed in the
         # directory word below 22 nodes; split dirs{w} planes beyond).
@@ -882,7 +921,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             msg_shw = [dec(heads, f"shr{w}") for w in range(SW)]
         else:
             msg_shw = [aux]
-        self_bitw = sv_bit(iota_n)
+        self_bitw = sv_bit(gids)
         inv_shw = [
             jnp.where(fan, msg_shw[w] & ~self_bitw[w], inv_shw[w])
             for w in range(SW)
@@ -1046,7 +1085,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             elig = false
         t_dim = s["tr"].shape[1]
         pcc = jnp.minimum(pc_in, t_dim - 1)
-        iota_tr = jax.lax.broadcasted_iota(I32, (n, t_dim, bb), 1)
+        iota_tr = jax.lax.broadcasted_iota(I32, (nl, t_dim, bb), 1)
         hot_tr = iota_tr == pcc[:, None, :]
         wi = jnp.sum(jnp.where(hot_tr, s["tr"], 0), axis=1)
         op = wi & 1
@@ -1144,7 +1183,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         # wire words [N, B] per slot: the sender field (the node's own
         # row index) is OR'd in once here, not at every put site
         sender_w, sender_off, _ = layout["sender"]
-        base_sender = iota_n << sender_off if sender_off else iota_n
+        base_sender = gids << sender_off if sender_off else gids
         words5 = [
             [
                 sl[f"w{w}"] | base_sender if w == sender_w
@@ -1155,95 +1194,311 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         ]
 
         mbs = qdata
-        acc = zero  # running enqueue offset per receiver
-        # accepted-receiver masks per candidate: [slot][sender] -> [N, B]
-        acc_masks = [[None] * n for _ in range(_NSLOTS)]
+        xmsg_loc = exch_over = None
+        if not sharded:
+            acc = zero  # running enqueue offset per receiver
+            # accepted-receiver masks per candidate:
+            # [slot][sender] -> [N, B]
+            acc_masks = [[None] * nl for _ in range(_NSLOTS)]
 
-        def enqueue(mbs, acc, valid_nb, words_r):
-            """Queue-write core: accept ``valid_nb`` receivers at the
-            current offsets, writing per-receiver word rows."""
-            pos = count2 + acc
-            accepted = valid_nb & (pos < cap)
-            acc_i = accepted.astype(I32)
-            # mask folded into the position compare (pos >= 0 always):
-            # no bool-vector broadcast (Mosaic i8->i1 hazard)
-            hot = iota_cap == jnp.where(accepted, pos, -1)[:, None, :]
-            mbs = [
-                jnp.where(hot, words_r[w][:, None, :], mbs[w])
-                for w in range(W)
-            ]
-            return mbs, acc + acc_i, accepted, acc_i
-
-        def candidate(mbs, acc, k, sender, valid_nb):
-            words_r = [words5[k][w][sender][None, :] for w in range(W)]
-            mbs, acc, _, acc_i = enqueue(mbs, acc, valid_nb, words_r)
-            acc_masks[k][sender] = acc_i
-            return mbs, acc
-
-        # the receiver row IS the validity map (-1 = empty slot), so
-        # the per-sender check is ONE i32 row broadcast + compare
-        # (bool rows can't be indexed/broadcast Mosaic-safely)
-        def point_valid(sl, sender):
-            return iota_n == sl["recv"][sender][None, :]
-
-        def inv_valid(sender):
-            # the same sign-safe per-word bit probe as directory tests
-            return sv_test(
-                [x[sender][None, :] for x in inv_shw], iota_n
-            )
-
-        if "deliver" in ablate:
-            for k_ in range(_NSLOTS):
-                for sender in range(n):
-                    acc_masks[k_][sender] = zero
-        else:
-            # One message per node per cycle makes a sender's three
-            # phase-A slots RECEIVER-DISJOINT by construction: A1 only
-            # exists for dual-destination FLUSH/FLUSH_INVACK with
-            # second != home (the A0 receiver), and the INV fan comes
-            # only from REPLY_ID, which makes no point sends.
-            # Deferral preserves disjointness (blocked nodes make no
-            # fresh sends).  So the three deliver as ONE candidate —
-            # valid masks OR'd, the word a per-receiver select — which
-            # is order-equivalent to the sequential walk because
-            # disjoint receivers never contend for the same queue
-            # slot.  Delivery drops from 5 to 3 candidates per sender
-            # (measured by jaxpr op count: the unrolled loop was 44%
-            # of the cycle).
-            for sender in range(n):
-                vA0 = point_valid(sA0, sender)
-                vA1 = point_valid(sA1, sender)
-                vInv = inv_valid(sender)
-                wsel = [
-                    jnp.where(
-                        vA1, words5[1][w][sender][None, :],
-                        jnp.where(
-                            vInv, words5[2][w][sender][None, :],
-                            words5[0][w][sender][None, :],
-                        ),
-                    )
+            def enqueue(mbs, acc, valid_nb, words_r):
+                """Queue-write core: accept ``valid_nb`` receivers at
+                the current offsets, writing per-receiver word rows."""
+                pos = count2 + acc
+                accepted = valid_nb & (pos < cap)
+                acc_i = accepted.astype(I32)
+                # mask folded into the position compare (pos >= 0
+                # always): no bool-vector broadcast (Mosaic i8->i1
+                # hazard)
+                hot = iota_cap == jnp.where(accepted, pos, -1)[:, None, :]
+                mbs = [
+                    jnp.where(hot, words_r[w][:, None, :], mbs[w])
                     for w in range(W)
                 ]
-                mbs, acc, accepted, _ = enqueue(
-                    mbs, acc, vA0 | vA1 | vInv, wsel
-                )
-                acc_masks[0][sender] = jnp.where(vA0 & accepted, 1, 0)
-                acc_masks[1][sender] = jnp.where(vA1 & accepted, 1, 0)
-                acc_masks[2][sender] = jnp.where(vInv & accepted, 1, 0)
-            for sender in range(n):
-                mbs, acc = candidate(mbs, acc, 3, sender,
-                                     point_valid(sB0, sender))
-                mbs, acc = candidate(mbs, acc, 4, sender,
-                                     point_valid(sB1, sender))
+                return mbs, acc + acc_i, accepted, acc_i
 
-        # post-loop bookkeeping on stacked masks (sums are order-free;
-        # masks are already i32 — stacking bool vectors is a Mosaic
-        # i8->i1 hazard)
-        accs = jnp.stack(
-            [jnp.stack(acc_masks[k], axis=0) for k in range(_NSLOTS)],
-            axis=1,
-        )                                      # [S(sender), 5, R(recv), B]
-        dcount = jnp.sum(accs, axis=2)         # [S, 5, B] per candidate
+            def candidate(mbs, acc, k, sender, valid_nb):
+                words_r = [
+                    words5[k][w][sender][None, :] for w in range(W)
+                ]
+                mbs, acc, _, acc_i = enqueue(mbs, acc, valid_nb, words_r)
+                acc_masks[k][sender] = acc_i
+                return mbs, acc
+
+            # the receiver row IS the validity map (-1 = empty slot),
+            # so the per-sender check is ONE i32 row broadcast +
+            # compare (bool rows can't be indexed/broadcast
+            # Mosaic-safely)
+            def point_valid(sl, sender):
+                return iota_n == sl["recv"][sender][None, :]
+
+            def inv_valid(sender):
+                # the same sign-safe per-word bit probe as directory
+                # tests
+                return sv_test(
+                    [x[sender][None, :] for x in inv_shw], iota_n
+                )
+
+            if "deliver" in ablate:
+                for k_ in range(_NSLOTS):
+                    for sender in range(nl):
+                        acc_masks[k_][sender] = zero
+            else:
+                # One message per node per cycle makes a sender's three
+                # phase-A slots RECEIVER-DISJOINT by construction: A1
+                # only exists for dual-destination FLUSH/FLUSH_INVACK
+                # with second != home (the A0 receiver), and the INV
+                # fan comes only from REPLY_ID, which makes no point
+                # sends.  Deferral preserves disjointness (blocked
+                # nodes make no fresh sends).  So the three deliver as
+                # ONE candidate — valid masks OR'd, the word a
+                # per-receiver select — which is order-equivalent to
+                # the sequential walk because disjoint receivers never
+                # contend for the same queue slot.  Delivery drops
+                # from 5 to 3 candidates per sender (measured by jaxpr
+                # op count: the unrolled loop was 44% of the cycle).
+                for sender in range(nl):
+                    vA0 = point_valid(sA0, sender)
+                    vA1 = point_valid(sA1, sender)
+                    vInv = inv_valid(sender)
+                    wsel = [
+                        jnp.where(
+                            vA1, words5[1][w][sender][None, :],
+                            jnp.where(
+                                vInv, words5[2][w][sender][None, :],
+                                words5[0][w][sender][None, :],
+                            ),
+                        )
+                        for w in range(W)
+                    ]
+                    mbs, acc, accepted, _ = enqueue(
+                        mbs, acc, vA0 | vA1 | vInv, wsel
+                    )
+                    acc_masks[0][sender] = jnp.where(vA0 & accepted, 1, 0)
+                    acc_masks[1][sender] = jnp.where(vA1 & accepted, 1, 0)
+                    acc_masks[2][sender] = jnp.where(vInv & accepted, 1, 0)
+                for sender in range(nl):
+                    mbs, acc = candidate(mbs, acc, 3, sender,
+                                         point_valid(sB0, sender))
+                    mbs, acc = candidate(mbs, acc, 4, sender,
+                                         point_valid(sB1, sender))
+
+            # post-loop bookkeeping on stacked masks (sums are
+            # order-free; masks are already i32 — stacking bool
+            # vectors is a Mosaic i8->i1 hazard)
+            accs = jnp.stack(
+                [jnp.stack(acc_masks[k], axis=0) for k in range(_NSLOTS)],
+                axis=1,
+            )                                  # [S(sender), 5, R(recv), B]
+            dcount = jnp.sum(accs, axis=2)     # [S, 5, B] per candidate
+
+            # rejected candidates defer to the sender outbox; the INV
+            # remainder (mask minus accepted receivers) rides the
+            # deferred word's aux union (packed) or shr{w} fields
+            # (split)
+            if SW == 1:
+                io_r = jax.lax.broadcasted_iota(I32, (nl, n, bb), 1)
+                remaining = [
+                    inv_shw[0] & ~jnp.sum(accs[:, 2, :, :] << io_r, axis=1)
+                ]
+            else:
+                remaining = []
+                for w in range(SW):
+                    lo = w * _SPLIT_BPW
+                    hi = min(n, lo + _SPLIT_BPW)
+                    io_r = jax.lax.broadcasted_iota(
+                        I32, (nl, hi - lo, bb), 1
+                    )
+                    remaining.append(
+                        inv_shw[w]
+                        & ~jnp.sum(accs[:, 2, lo:hi, :] << io_r, axis=1)
+                    )
+        else:
+            # ---- targeted cross-shard exchange (ops/exchange.py) ----
+            # Vectorized candidate-axis delivery at the XLA level:
+            # this branch never runs inside a Mosaic kernel
+            # (collectives are host-lowered under shard_map), so bool
+            # temporaries and fat [nl, J, bb] intermediates are fine.
+            # Entry order is the global candidate grid of ops/step.py:
+            # A-grid sender-major [A0, A1, INV] then B-grid [B0, B1]
+            # — which the unsharded per-sender walk is
+            # order-equivalent to, so dumps stay bit-identical.
+            me = jax.lax.axis_index(axis_name).astype(I32)
+            bpw = _SPLIT_BPW
+
+            def interleave(arrs):  # k x [nl, bb] -> [k*nl, bb]
+                return jnp.stack(arrs, axis=1).reshape(-1, bb)
+
+            cand_words = [
+                jnp.concatenate(
+                    [
+                        interleave([words5[0][w], words5[1][w],
+                                    words5[2][w]]),
+                        interleave([words5[3][w], words5[4][w]]),
+                    ],
+                    axis=0,
+                )
+                for w in range(W)
+            ]                                  # W x [J0, bb]
+            # per-candidate INV fan-mask words (A slot 2 only)
+            mask_words = [
+                jnp.concatenate(
+                    [
+                        interleave([zero, zero, inv_shw[sw]]),
+                        jnp.zeros((2 * nl, bb), I32),
+                    ],
+                    axis=0,
+                )
+                for sw in range(SW)
+            ]                                  # SW x [J0, bb]
+            # recv shipped +1 so zero-filled exchange slots (word 0)
+            # can never match receiver node 0
+            recv_p1 = jnp.concatenate(
+                [
+                    interleave(
+                        [slots5[k]["recv"] + 1 for k in (0, 1, 2)]
+                    ),
+                    interleave(
+                        [slots5[k]["recv"] + 1 for k in (3, 4)]
+                    ),
+                ],
+                axis=0,
+            )                                  # [J0, bb]; 0 = no point
+            isa_col = jnp.concatenate(
+                [
+                    jnp.ones((3 * nl, bb), I32),
+                    jnp.zeros((2 * nl, bb), I32),
+                ],
+                axis=0,
+            )
+            j0 = 5 * nl
+            payload = jnp.stack(
+                cand_words + mask_words + [recv_p1, isa_col], axis=0
+            )                                  # [W + SW + 2, J0, bb]
+            xmsg_loc = jnp.zeros((1, bb), I32)
+            exch_over = jnp.zeros((1, bb), I32)
+            bufs, sels = [], []
+            origins = [me]
+            for rnd in range(1, shards):
+                peer = (me + rnd) % shards
+                lo = peer * nl
+                dest_pt = (recv_p1 >= lo + 1) & (recv_p1 < lo + nl + 1)
+                rm_i = jax.lax.bitcast_convert_type(
+                    exchange.range_mask_words(lo, lo + nl, SW, bpw), I32
+                )
+                mhit = (mask_words[0] & rm_i[0]) != 0
+                for sw in range(1, SW):
+                    mhit = mhit | ((mask_words[sw] & rm_i[sw]) != 0)
+                dest = dest_pt | mhit
+                buf, sel, ovf = exchange.compact(dest, payload, k_slots)
+                bufs.append(
+                    jax.lax.ppermute(
+                        buf, axis_name, exchange.fwd_perm(shards, rnd)
+                    )
+                )
+                sels.append(sel)
+                origins.append(exchange.origin_of_round(me, shards, rnd))
+                xmsg_loc = xmsg_loc + jnp.sum(
+                    dest.astype(I32), axis=0, keepdims=True
+                )
+                if k_slots < j0:  # statically elided when capacity-exact
+                    exch_over = jnp.maximum(
+                        exch_over, jnp.minimum(ovf, 1)[None, :]
+                    )
+
+            def cat(i, local_row):
+                return jnp.concatenate(
+                    [local_row] + [b_[i] for b_ in bufs], axis=0
+                )
+
+            all_words = [cat(w, cand_words[w]) for w in range(W)]
+            all_mask = [cat(W + sw, mask_words[sw]) for sw in range(SW)]
+            all_recv = cat(W + SW, recv_p1)
+            all_isa = cat(W + SW + 1, isa_col)
+            bounds = [0, j0] + [
+                j0 + (i + 1) * k_slots for i in range(shards - 1)
+            ]
+            # validity per (receiver row, entry): point match on the
+            # shifted recv, or a fan-mask bit probe at the receiver's
+            # global id (zero-filled slots fail both)
+            pv_rj = gids[:, None, :] + 1 == all_recv[None, :, :]
+            # broadcast-safe fan-mask probe over [nl, J, bb] (sv_test's
+            # split path accumulates from a [nl, bb] zero and cannot
+            # broadcast against the entry axis)
+            g3 = gids[:, None, :]
+            inv_rj = None
+            for sw in range(SW):
+                b_ = g3 - sw * bpw
+                vw = (all_mask[sw][None, :, :] >> jnp.clip(b_, 0, 31)) & 1
+                h_ = jnp.where((b_ >= 0) & (b_ < bpw), vw, 0)
+                inv_rj = h_ if inv_rj is None else inv_rj | h_
+            inv_rj = inv_rj != 0
+            valid_rj = pv_rj | inv_rj          # [nl, J, bb]
+            # global delivery rank across [local | received] blocks —
+            # the received blocks sit in arrival (round) order, which
+            # is shard-dependent, so the rank is computed against the
+            # traced origin ids instead of a static permutation
+            offs = exchange.ordered_rank(
+                valid_rj & (all_isa[None, :, :] != 0),
+                valid_rj & (all_isa[None, :, :] == 0),
+                bounds, origins, axis=1,
+            )
+            pos = count2[:, None, :] + offs
+            accept = valid_rj & (pos < cap)
+            acc_i3 = accept.astype(I32)
+            acc = jnp.sum(acc_i3, axis=1)      # delivered per receiver
+            hot = (
+                iota_cap[:, :, None, :]
+                == jnp.where(accept, pos, -1)[:, None, :, :]
+            ).astype(I32)                      # [nl, cap, J, bb]
+            mbs = [
+                jnp.where(
+                    jnp.sum(hot, axis=2) > 0,
+                    jnp.sum(hot * all_words[w][None, None, :, :], axis=2),
+                    qdata[w],
+                )
+                for w in range(W)
+            ]
+            # acceptance feedback to the senders: per-entry accepted
+            # count + accepted-receiver bit words ride one reverse
+            # ppermute per round and scatter back onto the local
+            # candidate axis via the saved compaction placement
+            acc_e = jnp.sum(acc_i3, axis=0)    # [J, bb]
+            fb_bits = []
+            for sw in range(SW):
+                b_ = gids - sw * bpw
+                inw = (b_ >= 0) & (b_ < bpw)
+                fb_bits.append(
+                    jnp.sum(
+                        jnp.where(
+                            inw[:, None, :],
+                            acc_i3 << jnp.clip(b_, 0, 31)[:, None, :],
+                            0,
+                        ),
+                        axis=0,
+                    )
+                )                              # [J, bb]
+            fbrows = jnp.stack([acc_e] + fb_bits, axis=0)
+            acc_tot = fbrows[:, :j0]
+            for i, sel in enumerate(sels):
+                fb = jax.lax.ppermute(
+                    fbrows[:, bounds[i + 1]:bounds[i + 2]],
+                    axis_name, exchange.rev_perm(shards, i + 1),
+                )
+                acc_tot = acc_tot + exchange.uncompact(fb, sel)
+            acc_j = acc_tot[0]                 # [J0, bb] global accepts
+            dcount = jnp.concatenate(
+                [
+                    acc_j[: 3 * nl].reshape(nl, 3, bb),
+                    acc_j[3 * nl:].reshape(nl, 2, bb),
+                ],
+                axis=1,
+            )                                  # [S, 5, B] per candidate
+            remaining = [
+                inv_shw[sw] & ~acc_tot[1 + sw, 2: 3 * nl: 3]
+                for sw in range(SW)
+            ]
+
         md = jnp.sum(dcount, axis=(0, 1))[None, :]          # [1, B]
         # message-type decode straight off the wire word (empty slots
         # decode as type 0 but contribute dcount 0)
@@ -1253,33 +1508,13 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         mc = jnp.sum(
             jnp.where(
                 type_arr[None, :, :, :] == jax.lax.broadcasted_iota(
-                    I32, (_NTYPES, n, _NSLOTS, bb), 0
+                    I32, (_NTYPES, nl, _NSLOTS, bb), 0
                 ),
                 dcount[None, :, :, :], 0,
             ),
             axis=(1, 2),
         )                                      # [NTYPES, B]
 
-        # rejected candidates defer to the sender outbox; the INV
-        # remainder (mask minus accepted receivers) rides the deferred
-        # word's aux union (packed) or shr{w} fields (split)
-        if SW == 1:
-            io_r = jax.lax.broadcasted_iota(I32, (n, n, bb), 1)
-            remaining = [
-                inv_shw[0] & ~jnp.sum(accs[:, 2, :, :] << io_r, axis=1)
-            ]
-        else:
-            remaining = []
-            for w in range(SW):
-                lo = w * _SPLIT_BPW
-                hi = min(n, lo + _SPLIT_BPW)
-                io_r = jax.lax.broadcasted_iota(
-                    I32, (n, hi - lo, bb), 1
-                )
-                remaining.append(
-                    inv_shw[w]
-                    & ~jnp.sum(accs[:, 2, lo:hi, :] << io_r, axis=1)
-                )
         rem_any = remaining[0]
         for w in range(1, SW):
             rem_any = rem_any | remaining[w]
@@ -1340,7 +1575,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             # vanish without deferral (otherwise every candidate would
             # defer and block issue, and the outbox ops would stay in
             # the ablated graph instead of constant-folding away)
-            z5 = jnp.zeros((n, _NSLOTS, bb), I32)
+            z5 = jnp.zeros((nl, _NSLOTS, bb), I32)
             ob_recv_new = z5 - 1
             ob_new = [z5 for _ in range(W)]
             defer5 = [zero] * _NSLOTS
@@ -1393,30 +1628,84 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         # ===== counters ==============================================
         row = lambda x: jnp.sum(x.astype(I32), axis=0, keepdims=True)
         sc = s["scalars"]
-        # a lane only accrues a cycle while it has outstanding work at
-        # cycle start — the quiescence gate runs every _GATE cycles (or
-        # never, gate=False), so an unconditional increment would
-        # overshoot quiescence by up to the gate window and diverge
-        # from the spec/native cycle counters
-        lane_active = (
-            jnp.sum(jnp.maximum(s["tr_len"] - pc_in, 0), axis=0,
-                    keepdims=True)
-            + jnp.sum(waiting_in, axis=0, keepdims=True)
-            + jnp.sum(mb_count_in, axis=0, keepdims=True)
-            + jnp.sum(dv, axis=(0, 1))[None, :]
-        )
-        upd = [
-            (_SC_CYCLE, jnp.minimum(lane_active, 1)),
-            (_SC_INSTR, row(elig)),
-            (_SC_MSGS, md),
-            (_SC_OVERFLOW, ov_inc),
-            (_SC_RH, row(is_rd & hit)),
-            (_SC_RM, row(rm)),
-            (_SC_WH, row(is_wr & hit)),
-            (_SC_WM, row(wm)),
-            (_SC_EV, row(ev_replyrd | ev_flush | ev_issue)),
-            (_SC_INV, row(inv_applied)),
-        ]
+        if not sharded:
+            # a lane only accrues a cycle while it has outstanding work
+            # at cycle start — the quiescence gate runs every _GATE
+            # cycles (or never, gate=False), so an unconditional
+            # increment would overshoot quiescence by up to the gate
+            # window and diverge from the spec/native cycle counters
+            lane_active = (
+                jnp.sum(jnp.maximum(s["tr_len"] - pc_in, 0), axis=0,
+                        keepdims=True)
+                + jnp.sum(waiting_in, axis=0, keepdims=True)
+                + jnp.sum(mb_count_in, axis=0, keepdims=True)
+                + jnp.sum(dv, axis=(0, 1))[None, :]
+            )
+            upd = [
+                (_SC_CYCLE, jnp.minimum(lane_active, 1)),
+                (_SC_INSTR, row(elig)),
+                (_SC_MSGS, md),
+                (_SC_OVERFLOW, ov_inc),
+                (_SC_RH, row(is_rd & hit)),
+                (_SC_RM, row(rm)),
+                (_SC_WH, row(is_wr & hit)),
+                (_SC_WM, row(wm)),
+                (_SC_EV, row(ev_replyrd | ev_flush | ev_issue)),
+                (_SC_INV, row(inv_applied)),
+            ]
+            mc_g = mc
+        else:
+            # ONE stacked psum carries every cross-shard reduction of
+            # the cycle: end-of-cycle global activity (next cycle's
+            # lane-active gate — end state at cycle t IS start state at
+            # t+1), cross-shard message count, exchange overflow,
+            # mailbox overflow, and the 8 + NTYPES counter rows.  The
+            # collective-count guard pins the loop to the 2*(D-1)
+            # ppermutes plus exactly this psum.
+            end_active = (
+                jnp.sum(jnp.maximum(tr_len - pc, 0), axis=0,
+                        keepdims=True)
+                + jnp.sum(waiting, axis=0, keepdims=True)
+                + jnp.sum(mb_count3, axis=0, keepdims=True)
+                + sum(jnp.sum(d5, axis=0, keepdims=True)
+                      for d5 in defer5)
+            )
+            g = jax.lax.psum(
+                jnp.concatenate(
+                    [
+                        end_active, xmsg_loc, exch_over, ov_inc,
+                        row(elig), md, row(is_rd & hit), row(rm),
+                        row(is_wr & hit), row(wm),
+                        row(ev_replyrd | ev_flush | ev_issue),
+                        row(inv_applied), mc,
+                    ],
+                    axis=0,
+                ),
+                axis_name,
+            )                              # [12 + NTYPES, B] replicated
+            upd = [
+                # previous cycle's psum'd end-activity == this cycle's
+                # start activity (the runner seeds activeg with one
+                # psum of the initial state, outside the loop)
+                (_SC_CYCLE, jnp.minimum(s["activeg"], 1)),
+                (_SC_INSTR, g[4:5]),
+                (_SC_MSGS, g[5:6]),
+                (_SC_OVERFLOW, jnp.minimum(g[3:4], 1)),
+                (_SC_RH, g[6:7]),
+                (_SC_RM, g[7:8]),
+                (_SC_WH, g[8:9]),
+                (_SC_WM, g[9:10]),
+                (_SC_EV, g[10:11]),
+                (_SC_INV, g[11:12]),
+            ]
+            mc_g = g[12:]
+            # transient rows threaded by the node-sharded runner (not
+            # part of state_shapes): global activity for the quiescence
+            # gate, cumulative cross-shard messages, sticky exchange
+            # overflow
+            out["activeg"] = g[0:1]
+            out["xmsgs"] = s["xmsgs"] + g[1:2]
+            out["exchov"] = jnp.maximum(s["exchov"], g[2:3])
         iota_sc = jax.lax.broadcasted_iota(I32, (_NSCALAR, bb), 0)
         inc = jnp.zeros((_NSCALAR, bb), I32)
         for rid, val in upd:
@@ -1425,7 +1714,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         out["scalars"] = jnp.where(
             iota_sc == _SC_OVERFLOW, jnp.maximum(sc, inc), sc + inc
         )
-        out["msg_counts"] = s["msg_counts"] + mc
+        out["msg_counts"] = s["msg_counts"] + mc_g
         return out
 
     if not packed:

@@ -29,12 +29,20 @@ Multi-chip: ``build_step(config, axis_name=..., shards=D)`` builds the
 *same* cycle as a per-shard SPMD program for ``jax.shard_map`` over a
 mesh axis holding ``num_procs / D`` nodes per device.  Phases A/B/D are
 purely node-local; phase C's delivery — the reference's shared-memory
-mailbox enqueue (assignment.c:711-739) — becomes one ``all_gather`` of
-the fixed-shape candidate tensor over ICI, after which every shard
-scatters its own receivers' messages locally.  Candidate order is
-preserved exactly (shards hold contiguous node blocks, and the gather
-is tiled in axis order), so the sharded engine is bit-identical to the
-single-chip one (see tests/test_parallel.py).
+mailbox enqueue (assignment.c:711-739) — is a *targeted* exchange
+(``ops/exchange.py``): each shard buckets its candidates by destination
+shard (point sends by ``recv // n_local``, INV multicasts by which
+shards hold sharer-mask bits), compacts each bucket and ships it with
+one ``ppermute`` per round; acceptance feedback returns along the
+reverse permutation and all global counters fold into ONE stacked
+``psum`` — 2*(D-1) ppermutes + 1 psum per cycle, no per-cycle
+``all_gather`` of the world.  Delivery order is reconstructed exactly
+(``exchange.ordered_rank`` over origin-tagged blocks), so the sharded
+engine is bit-identical to the single-chip one (see
+tests/test_parallel.py).  Fault injection composes: the node-shard
+index is folded into the link-layer mask keys so each shard draws an
+independent stream, and the retransmission masking invariant keeps
+dumps byte-identical to the unsharded faulty run.
 """
 
 from __future__ import annotations
@@ -47,7 +55,7 @@ import jax.numpy as jnp
 
 from hpa2_tpu.config import SystemConfig
 from hpa2_tpu.models.protocol import CacheState, DirState, MsgType
-from hpa2_tpu.ops import bits
+from hpa2_tpu.ops import bits, exchange
 from hpa2_tpu.ops.state import (
     MB_ADDR,
     MB_SECOND,
@@ -172,7 +180,10 @@ def build_step(
     With ``axis_name``/``shards`` the returned function is the
     per-shard SPMD body for ``jax.shard_map``: every node-leading array
     it sees is the local block of ``num_procs // shards`` nodes, and
-    phase C all-gathers send candidates over the mesh axis.
+    phase C moves only the candidates that actually cross shards via
+    the targeted ``ppermute`` exchange (``ops/exchange.py``) — exactly
+    ``2*(shards-1)`` ppermutes plus one stacked counter ``psum`` per
+    cycle.
     """
     n = config.num_procs
     c = config.cache_size
@@ -204,14 +215,6 @@ def build_step(
     nack = sem.intervention_miss_policy == "nack"
     fault = config.fault
     fault_on = fault.enabled  # static: fault-free builds add zero ops
-    if fault_on and axis_name is not None and shards > 1:
-        # data sharding (shards == 1 on the node axis) keeps whole
-        # systems per device, so the per-system PRNG stream is intact;
-        # only an actual node split would tear it across devices
-        raise ValueError(
-            "fault injection is single-node-shard only (the link-layer "
-            "PRNG stream is per-system, not per-node-shard)"
-        )
     drop_p = float(fault.drop)
     n_local = n // shards
     local_ids = jnp.arange(n_local, dtype=I32)
@@ -651,34 +654,146 @@ def build_step(
 
         fa = stack_slots([sA0, sA1], inv=True)
         fb = stack_slots([sB0, sB1])
-        if axis_name is None:
-            inv_all = inv_sharers
-        else:
-            # the mailbox-enqueue boundary (assignment.c:711-739) as an
-            # ICI collective: every shard contributes its candidate
-            # block; tiled gather in axis order keeps the global
-            # candidate order identical to the single-chip engine
-            # (shards own contiguous node blocks, phase A before B)
-            def _gather(x):
-                return jax.lax.all_gather(x, axis_name, tiled=True)
-
-            fa = {key: _gather(val) for key, val in fa.items()}
-            fb = {key: _gather(val) for key, val in fb.items()}
-            inv_all = _gather(inv_sharers)
-        f = {
+        floc = {
             key: jnp.concatenate([fa[key], fb[key]], axis=0)
             for key in fa
         }
-        j = f["valid"].shape[0]  # 5N candidates
+        j0 = floc["valid"].shape[0]  # 5 * n_local local candidates
+        # per-candidate INV fan mask (A-grid slot 2; zero elsewhere)
+        zw = jnp.zeros((n_local, w), dtype=U32)
+        mask_loc = jnp.concatenate(
+            [
+                jnp.stack([zw, zw, inv_sharers], axis=1).reshape(-1, w),
+                jnp.zeros((2 * n_local, w), dtype=U32),
+            ],
+            axis=0,
+        )
+        # global candidate-grid ids: the delivery / per-edge FIFO order
+        # key (for one shard this is just arange(j0))
+        gid_loc = jnp.concatenate(
+            [
+                (
+                    3 * node_ids[:, None]
+                    + jnp.arange(3, dtype=I32)[None, :]
+                ).reshape(-1),
+                3 * n
+                + (
+                    2 * node_ids[:, None]
+                    + jnp.arange(2, dtype=I32)[None, :]
+                ).reshape(-1),
+            ]
+        )
+        isa_loc = jnp.concatenate(
+            [
+                jnp.ones((3 * n_local,), dtype=I32),
+                jnp.zeros((2 * n_local,), dtype=I32),
+            ]
+        )
+        pv_loc = floc["valid"] & ~floc["is_inv"]
+        # one shipped word set per candidate: point entries carry their
+        # sharer words, INV entries their fan mask (the other side is
+        # zero by construction; receivers split the union on is_inv)
+        comb_loc = mask_loc | floc["sharers"]
+
+        sharded = axis_name is not None and shards > 1
+        if not sharded:
+            f = floc
+            gid = gid_loc
+            isa = isa_loc
+            comb = comb_loc
+            bounds = [0, j0]
+            origins = [jnp.zeros((), dtype=I32)]
+            sels = []
+        else:
+            # targeted exchange (ops/exchange.py): bucket candidates by
+            # destination shard (point sends by recv // n_local, INV
+            # multicasts by which shards hold fan-mask bits), compact
+            # each bucket into a capacity-exact K = 5*n_local buffer
+            # (overflow-free by construction) and ship it with one
+            # ppermute per round — the old tiled all_gather moved the
+            # whole 5N grid every cycle instead.
+            me = jax.lax.axis_index(axis_name).astype(I32)
+            payload = jnp.stack(
+                [
+                    floc["type"], floc["sender"], floc["addr"],
+                    floc["value"], floc["second"], floc["recv"],
+                    gid_loc, floc["is_inv"].astype(I32), isa_loc,
+                    pv_loc.astype(I32),
+                ]
+                + [
+                    jax.lax.bitcast_convert_type(comb_loc[:, wi], I32)
+                    for wi in range(w)
+                ],
+                axis=0,
+            )  # [10 + W, J0]
+            k_slots = j0
+            bufs, sels = [], []
+            origins = [me]
+            for rnd in range(1, shards):
+                peer = (me + rnd) % shards
+                lo = peer * n_local
+                dest_pt = pv_loc & (floc["recv"] // n_local == peer)
+                rmask = exchange.range_mask_words(lo, lo + n_local, w, 32)
+                dest_inv = floc["is_inv"] & jnp.any(
+                    (comb_loc & rmask[None, :]) != 0, axis=1
+                )
+                buf, sel, _ = exchange.compact(
+                    dest_pt | dest_inv, payload, k_slots
+                )
+                bufs.append(
+                    jax.lax.ppermute(
+                        buf, axis_name, exchange.fwd_perm(shards, rnd)
+                    )
+                )
+                sels.append(sel)
+                origins.append(exchange.origin_of_round(me, shards, rnd))
+
+            def cat(i, local_row):
+                return jnp.concatenate(
+                    [local_row] + [b[i] for b in bufs], axis=0
+                )
+
+            f = {
+                "type": cat(0, floc["type"]),
+                "sender": cat(1, floc["sender"]),
+                "addr": cat(2, floc["addr"]),
+                "value": cat(3, floc["value"]),
+                "second": cat(4, floc["second"]),
+                "recv": cat(5, floc["recv"]),
+                "is_inv": cat(7, floc["is_inv"].astype(I32)) != 0,
+            }
+            gid = cat(6, gid_loc)
+            isa = cat(8, isa_loc)
+            pv_row = cat(9, pv_loc.astype(I32)) != 0
+            comb = jax.lax.bitcast_convert_type(
+                jnp.stack(
+                    [
+                        cat(
+                            10 + wi,
+                            jax.lax.bitcast_convert_type(
+                                comb_loc[:, wi], I32
+                            ),
+                        )
+                        for wi in range(w)
+                    ],
+                    axis=1,
+                ),
+                U32,
+            )  # [J, W]
+            # zero-filled buffer slots are inert: both masks stay false
+            f["valid"] = pv_row | f["is_inv"]
+            f["sharers"] = jnp.where(f["is_inv"][:, None], U32(0), comb)
+            bounds = [0, j0] + [
+                j0 + (i + 1) * k_slots for i in range(shards - 1)
+            ]
+        j = f["valid"].shape[0]
 
         # validity per (receiver, candidate)
         point_valid = f["valid"] & ~f["is_inv"]  # [J]
         # inv candidate j is valid for receiver r iff bit r set in the
-        # sender's inv mask
+        # sender's fan mask (shipped per candidate — no gather)
         inv_mask_j = jnp.where(
-            f["is_inv"][:, None],
-            inv_all[f["sender"]],
-            jnp.zeros((j, w), dtype=U32),
+            f["is_inv"][:, None], comb, jnp.zeros((j, w), dtype=U32)
         )  # [J, W]
         r_word = node_ids // 32
         r_bit = (node_ids % 32).astype(U32)
@@ -705,6 +820,16 @@ def build_step(
             k_drop, k_dup, k_reo, k_del, rng_key = jax.random.split(
                 st.rng_key, 5
             )
+            if sharded:
+                # each node shard draws an independent link-layer
+                # stream (the carried rng_key stays replicated); the
+                # retransmission masking invariant makes the dumps
+                # byte-identical to the unsharded faulty run anyway
+                sid = jax.lax.axis_index(axis_name)
+                k_drop = jax.random.fold_in(k_drop, sid)
+                k_dup = jax.random.fold_in(k_dup, sid)
+                k_reo = jax.random.fold_in(k_reo, sid)
+                k_del = jax.random.fold_in(k_del, sid)
             applies = jnp.ones((n_local, j), dtype=bool)
             if fault.edge_sender != -1:
                 applies = applies & (
@@ -729,7 +854,10 @@ def build_step(
             failures = jnp.where(applies & valid_rj, failures, 0)
             wire_fail = failures >= fault.max_retries
             # same_sender[k, j'] = candidate j' precedes k on k's edge
-            cand_ids = jnp.arange(j, dtype=I32)
+            # (keyed by the global grid id, which is the edge order in
+            # every sharding; zero-filled exchange slots have gid 0 but
+            # contribute nothing — their failures are masked to 0)
+            cand_ids = gid
             same_sender = (
                 f["sender"][:, None] == f["sender"][None, :]
             ) & (cand_ids[:, None] > cand_ids[None, :])
@@ -753,7 +881,24 @@ def build_step(
         # ACCEPTED candidate the exclusive prefix count of valid
         # candidates equals the prefix count of accepted ones — offs
         # stays the exact enqueue position.
-        offs = jnp.cumsum(valid_ok.astype(I32), axis=1) - valid_ok.astype(I32)
+        if not sharded:
+            offs = (
+                jnp.cumsum(valid_ok.astype(I32), axis=1)
+                - valid_ok.astype(I32)
+            )
+        else:
+            # the received blocks sit in arrival (round) order, which
+            # is shard-dependent; rank every entry in the global
+            # (phase, origin, slot) candidate order instead — the
+            # drop-in sharded replacement for the prefix sum
+            isa_r = isa[None, :] != 0
+            offs = exchange.ordered_rank(
+                valid_ok & isa_r,
+                valid_ok & ~isa_r,
+                bounds,
+                origins,
+                axis=1,
+            )
         avail = jnp.maximum(cap - mb_count2, 0)
         accept_rj = valid_ok & (offs < avail[:, None])
         delivered = jnp.sum(accept_rj.astype(I32), axis=1)
@@ -800,46 +945,46 @@ def build_step(
         mb_count3 = mb_count2 + delivered
         ov_now = jnp.any(mb_count3 > cap)
 
-        # -- deferred-send outbox update ------------------------------
-        # a point candidate has exactly one receiver, so "accepted" is
-        # one reduction over receivers (psum'd across shards: the
-        # receiver may live elsewhere)
-        acc_j = jnp.sum(accept_rj.astype(I32), axis=0)        # [J]
-        if axis_name is not None:
-            acc_j = jax.lax.psum(acc_j, axis_name)
-        rejected_pt = point_valid & (acc_j == 0)
-        # inv fan-out: pack the accepted receiver bits of every inv
-        # candidate (phase-A slot 2, global sender s at column 3s) back
-        # into per-sender sharer words; bits from different shards
-        # never collide, so an int32 psum is an exact OR
-        inv_acc = accept_rj[:, : 3 * n][:, 2::3]              # [Nl, n]
+        # -- acceptance feedback to the senders -----------------------
+        # per-ENTRY accepted count plus accepted-receiver bit words;
+        # remote entries return to their origin shard with one reverse
+        # ppermute per round and are scattered back onto the local
+        # candidate axis via the saved compaction placement (replacing
+        # the old whole-grid psum).  Bits from different shards never
+        # collide, so an int32 sum is an exact OR.
+        acc_e = jnp.sum(accept_rj.astype(I32), axis=0)        # [J]
         shifted = jax.lax.bitcast_convert_type(
-            inv_acc.astype(U32) << (node_ids % 32).astype(U32)[:, None], I32
-        )
-        word_sel = (node_ids // 32)[None, :] == jnp.arange(w, dtype=I32)[:, None]
+            accept_rj.astype(U32)
+            << (node_ids % 32).astype(U32)[:, None],
+            I32,
+        ).T                                                   # [J, Nl]
+        word_sel = (
+            (node_ids // 32)[None, :] == jnp.arange(w, dtype=I32)[:, None]
+        )                                                     # [W, Nl]
         done_bits = jnp.sum(
-            jnp.where(word_sel[:, :, None], shifted[None, :, :], 0), axis=1
-        )                                                     # [W, n]
-        if axis_name is not None:
-            done_bits = jax.lax.psum(done_bits, axis_name)
-        delivered_inv = jax.lax.bitcast_convert_type(
-            done_bits.T, U32
-        )                                                     # [n, W]
-
-        # slice the local senders' grid region (global sender g0..)
-        if axis_name is None:
-            g0 = 0
-            take = lambda arr, start, size: arr[start : start + size]
-        else:
-            g0 = jax.lax.axis_index(axis_name).astype(I32) * n_local
-            take = lambda arr, start, size: jax.lax.dynamic_slice_in_dim(
-                arr, start, size, 0
+            jnp.where(word_sel[:, None, :], shifted[None, :, :], 0),
+            axis=2,
+        )                                                     # [W, J]
+        fbrows = jnp.concatenate([acc_e[None, :], done_bits], axis=0)
+        acc_tot = fbrows[:, :j0]
+        for i, sel in enumerate(sels):
+            fb = jax.lax.ppermute(
+                fbrows[:, bounds[i + 1] : bounds[i + 2]],
+                axis_name,
+                exchange.rev_perm(shards, i + 1),
             )
-        rejA = take(rejected_pt, 3 * g0, 3 * n_local).reshape(n_local, 3)
-        rejB = take(
-            rejected_pt, 3 * n + 2 * g0, 2 * n_local
-        ).reshape(n_local, 2)
-        rem_inv = inv_sharers & ~take(delivered_inv, g0, n_local)
+            acc_tot = acc_tot + exchange.uncompact(fb, sel)
+        acc_j = acc_tot[0]                                    # [J0]
+        # a point candidate has exactly one receiver, so "accepted" is
+        # acc_j > 0; inv candidates read their accepted-receiver bits
+        # back from the fan-out rows (A-grid slot 2 per local sender)
+        delivered_inv = jax.lax.bitcast_convert_type(
+            acc_tot[1:, 2 : 3 * n_local : 3].T, U32
+        )                                                     # [Nl, W]
+        rejected_pt = pv_loc & (acc_j == 0)
+        rejA = rejected_pt[: 3 * n_local].reshape(n_local, 3)
+        rejB = rejected_pt[3 * n_local :].reshape(n_local, 2)
+        rem_inv = inv_sharers & ~delivered_inv
         ob_valid = jnp.stack(
             [
                 rejA[:, 0],
@@ -852,9 +997,9 @@ def build_step(
         )
 
         def _ob_field(name):
-            arr = f[name]
-            fa_l = take(arr, 3 * g0, 3 * n_local).reshape(n_local, 3)
-            fb_l = take(arr, 3 * n + 2 * g0, 2 * n_local).reshape(n_local, 2)
+            arr = floc[name]
+            fa_l = arr[: 3 * n_local].reshape(n_local, 3)
+            fb_l = arr[3 * n_local :].reshape(n_local, 2)
             return jnp.concatenate([fa_l, fb_l], axis=1)      # [Nl, 5]
 
         ob_recv = _ob_field("recv")
@@ -864,10 +1009,8 @@ def build_step(
         ob_second = _ob_field("second")
         sh_l = jnp.concatenate(
             [
-                take(f["sharers"], 3 * g0, 3 * n_local).reshape(n_local, 3, w),
-                take(f["sharers"], 3 * n + 2 * g0, 2 * n_local).reshape(
-                    n_local, 2, w
-                ),
+                floc["sharers"][: 3 * n_local].reshape(n_local, 3, w),
+                floc["sharers"][3 * n_local :].reshape(n_local, 2, w),
             ],
             axis=1,
         )                                                     # [Nl, 5, W]
@@ -886,38 +1029,18 @@ def build_step(
         wr_miss_inc = cnt(wm)
         ev_inc = cnt(ev_replyrd | ev_flush | ev_issue)
         inv_inc = cnt(inv_applied)
-        # sends by transaction type: fan-out count per candidate
-        # (receivers holding it valid), bucketed by the type column
-        cand_cnt = jnp.sum(accept_rj.astype(I32), axis=0)  # [J]
+        # sends by transaction type: global fan-out count per local
+        # candidate (the feedback total), bucketed by the type column
         type_ids = jnp.arange(len(MsgType), dtype=I32)
         mc_inc = jnp.sum(
             jnp.where(
-                f["type"][None, :] == type_ids[:, None],
-                cand_cnt[None, :],
+                floc["type"][None, :] == type_ids[:, None],
+                acc_j[None, :],
                 0,
             ),
             axis=1,
         )  # [len(MsgType)]
         handled_cnt = cnt(has_msg)
-        if axis_name is not None:
-            # replicate the global counters so out_specs stay P()
-            ov_now = jax.lax.psum(ov_now.astype(I32), axis_name) > 0
-            instr_inc = jax.lax.psum(instr_inc, axis_name)
-            msgs_inc = jax.lax.psum(msgs_inc, axis_name)
-            rd_hit_inc = jax.lax.psum(rd_hit_inc, axis_name)
-            rd_miss_inc = jax.lax.psum(rd_miss_inc, axis_name)
-            wr_hit_inc = jax.lax.psum(wr_hit_inc, axis_name)
-            wr_miss_inc = jax.lax.psum(wr_miss_inc, axis_name)
-            ev_inc = jax.lax.psum(ev_inc, axis_name)
-            inv_inc = jax.lax.psum(inv_inc, axis_name)
-            mc_inc = jax.lax.psum(mc_inc, axis_name)
-            handled_cnt = jax.lax.psum(handled_cnt, axis_name)
-        overflow = st.overflow | ov_now
-
-        # watchdog progress: an instruction retired or a mailbox
-        # drained this cycle (matches SpecEngine.last_activity_cycle)
-        progressed = (instr_inc > 0) | (handled_cnt > 0)
-        last_progress = jnp.where(progressed, st.cycle, st.last_progress)
 
         # fault-layer counters (stay exactly zero when fault-free)
         zero = jnp.zeros((), dtype=I32)
@@ -935,6 +1058,46 @@ def build_step(
             dup_inc = _event_cnt(k_dup, float(fault.duplicate))
             reo_inc = _event_cnt(k_reo, float(fault.reorder))
             del_inc = _event_cnt(k_del, float(fault.delay))
+
+        if axis_name is not None:
+            # replicate every global counter (out_specs stay P()) with
+            # ONE stacked psum — the collective-count guards pin the
+            # cycle loop to the exchange ppermutes plus this psum
+            parts = [
+                jnp.stack(
+                    [
+                        ov_now.astype(I32), instr_inc, msgs_inc,
+                        rd_hit_inc, rd_miss_inc, wr_hit_inc,
+                        wr_miss_inc, ev_inc, inv_inc, handled_cnt,
+                    ]
+                ),
+                mc_inc,
+            ]
+            if fault_on:
+                parts.append(
+                    jnp.stack(
+                        [retrans_inc, wstall_inc, dup_inc, reo_inc,
+                         del_inc]
+                    )
+                )
+            vec = jax.lax.psum(jnp.concatenate(parts), axis_name)
+            nt = len(MsgType)
+            ov_now = vec[0] > 0
+            (instr_inc, msgs_inc, rd_hit_inc, rd_miss_inc, wr_hit_inc,
+             wr_miss_inc, ev_inc, inv_inc, handled_cnt) = [
+                vec[i] for i in range(1, 10)
+            ]
+            mc_inc = vec[10 : 10 + nt]
+            if fault_on:
+                (retrans_inc, wstall_inc, dup_inc, reo_inc, del_inc) = [
+                    vec[10 + nt + i] for i in range(5)
+                ]
+        overflow = st.overflow | ov_now
+
+        # watchdog progress: an instruction retired or a mailbox
+        # drained this cycle (matches SpecEngine.last_activity_cycle)
+        progressed = (instr_inc > 0) | (handled_cnt > 0)
+        last_progress = jnp.where(progressed, st.cycle, st.last_progress)
 
         # ============== phase D: dump-at-local-completion =============
         done_node = (

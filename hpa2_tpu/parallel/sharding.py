@@ -12,10 +12,13 @@ scaling axes become mesh axes:
 * ``node`` — the simulated-node axis *within* one system (the TP/SP
   analog): each device owns a contiguous block of nodes — their
   caches, directory slices, memory slices and mailboxes.  Cross-device
-  message delivery is one ``all_gather`` of the fixed-shape send
-  candidate tensor per cycle over ICI (see ops/step.py phase C); the
-  gather order is chosen so the sharded engine is *bit-identical* to
-  the single-chip engine.
+  message delivery is the *targeted* exchange of ``ops/exchange.py``:
+  outgoing messages are bucketed by destination shard and moved in
+  ``D-1`` ppermute rounds (plus a feedback round each), so ICI carries
+  only the messages that actually cross shards — never a per-cycle
+  ``all_gather`` of the whole candidate tensor.  Delivery order is
+  arranged so the sharded engine is *bit-identical* to the single-chip
+  engine.
 
 Both axes compose: ``shard_map(vmap(step))`` over a 2-D
 ``Mesh(('data', 'node'))`` runs a sharded ensemble of sharded systems.
@@ -36,7 +39,8 @@ from hpa2_tpu import hostenv
 from hpa2_tpu.config import SystemConfig
 from hpa2_tpu.models.protocol import Instr
 from hpa2_tpu.models.spec_engine import StallError
-from hpa2_tpu.ops.engine import JaxEngine, _node_dump_from, stack_states
+from hpa2_tpu.ops.engine import (
+    JaxEngine, _node_dump_from, engine_stats, stack_states)
 from hpa2_tpu.ops.pallas_engine import PallasEngine, choose_block
 from hpa2_tpu.ops.state import SimState, init_state
 from hpa2_tpu.ops.step import build_step, quiescent
@@ -127,9 +131,11 @@ def build_node_sharded_run(
     ``data``).
 
     The ``lax.while_loop`` lives *outside* the ``shard_map``: the loop
-    body is the manually-sharded SPMD step (one ICI all_gather per
-    cycle), while the quiescence condition is computed on the global
-    view so XLA inserts the cross-device reductions itself.
+    body is the manually-sharded SPMD step (the targeted ppermute
+    exchange of ``ops/exchange.py`` — ``2*(D-1)`` ppermutes plus one
+    stacked counter psum per cycle, no per-cycle all_gather), while the
+    quiescence condition is computed on the global view so XLA inserts
+    the cross-device reductions itself.
 
     ``watchdog_cycles`` > 0 adds the stall watchdog to the loop
     condition exactly as in ops/step.py's ``build_run``: stop once no
@@ -197,8 +203,9 @@ class NodeShardedEngine:
     The scaling analog of the reference's thread-per-node OpenMP region
     (assignment.c:135-137) when one chip is not enough nodes: each
     device simulates ``num_procs / node_shards`` nodes; mailbox traffic
-    crosses ICI as an all-gathered candidate tensor.  Dump readback and
-    quiescence semantics match :class:`JaxEngine` exactly.
+    crosses ICI through the targeted per-destination exchange
+    (``ops/exchange.py``).  Dump readback and quiescence semantics
+    match :class:`JaxEngine` exactly.
     """
 
     def __init__(
@@ -258,6 +265,9 @@ class NodeShardedEngine:
     @property
     def messages(self) -> int:
         return int(self.state.n_msgs)
+
+    def stats(self) -> dict:
+        return engine_stats(self.state)
 
 
 class GridEngine:
@@ -611,3 +621,514 @@ class DataShardedPallasEngine(PallasEngine):
         return jax.device_put(
             x, NamedSharding(self.mesh, _lane_spec(x.ndim))
         )
+
+
+# ---------------------------------------------------------------------------
+# Node-axis sharding for the Pallas fast path: one giant system (or an
+# ensemble of them) split into contiguous node blocks over the mesh's
+# ``node`` axis, composing with ``data`` lane sharding on the same 2-D
+# mesh.  Collectives cannot run inside a Mosaic kernel, so this path
+# runs ``build_cycle`` at the XLA level under ``shard_map``: phase C is
+# the targeted exchange of ``ops/exchange.py`` — exactly ``2*(D-1)``
+# ppermutes plus ONE stacked counter psum per cycle, no per-cycle
+# all_gather (tests/test_node_sharded_pallas.py pins the counts) — and
+# quiescence rides the psum'd ``activeg`` row for free.
+# ---------------------------------------------------------------------------
+
+# transient [1, lanes] rows threaded through the node-sharded cycle in
+# the state dict (never part of pallas_engine.state_shapes): psum'd
+# global activity (the quiescence gate), cumulative cross-shard
+# messages, sticky exchange-overflow flag
+_PALLAS_TRANSIENTS = ("activeg", "xmsgs", "exchov")
+
+
+def _node_plane_spec(key: str, ndim: int) -> P:
+    """Spec for one Pallas state plane on the 2-D (data, node) mesh:
+    node-leading planes split their leading axis over ``node``; the
+    replicated planes (scalars, msg_counts, transients) only shard the
+    trailing lane axis over ``data``."""
+    if key in ("scalars", "msg_counts") or key in _PALLAS_TRANSIENTS:
+        return P(*([None] * (ndim - 1)), "data")
+    return P("node", *([None] * (ndim - 2)), "data")
+
+
+def _make_node_pallas_interval(
+    config: SystemConfig,
+    bb: int,
+    snapshots: bool,
+    window: int,
+    n_seg: int,
+    max_calls: int,
+    k: int,
+    node_shards: int,
+    exchange_slots: Optional[int],
+    packed: bool,
+):
+    """The per-shard (state, tr_full, tr_len_full) -> (state, status)
+    interval program — ``pallas_engine._make_run.run_all`` rebuilt at
+    the XLA level around the node-sharded cycle.  ``state`` carries the
+    ``_PALLAS_TRANSIENTS`` rows; quiescence is ``any(activeg > 0)``
+    (the previous cycle's stacked psum), seeded once per trace window
+    by a single psum OUTSIDE the cycle loop.  Overshoot cycles on a
+    quiescent state are value-no-ops (and ``_SC_CYCLE`` only accrues
+    while active), so checking every ``k``-cycle granule keeps results
+    bit-identical to the single-chip engine."""
+    from hpa2_tpu.ops import pallas_engine as pe
+
+    cycle = pe.build_cycle(
+        config, bb, snapshots, frozenset(), packed, "node", node_shards,
+        exchange_slots,
+    )
+    slsc = pe._scalar_layout(config, window)
+
+    def local_activity(st, tl):
+        nswv = st["nsw"]
+        pc = (nswv >> slsc["off_pc"]) & slsc["pc_mask"]
+        waiting = (nswv >> slsc["off_wait"]) & 1
+        cnt = nswv & slsc["count_mask"]
+        dv = pe.deferred_valid(config, st)
+        return (
+            jnp.sum(jnp.maximum(tl - pc, 0), axis=0, keepdims=True)
+            + jnp.sum(waiting, axis=0, keepdims=True)
+            + jnp.sum(cnt, axis=0, keepdims=True)
+            + jnp.sum(dv.astype(jnp.int32), axis=(0, 1))[None, :]
+        )
+
+    def run_all(state, tr_full, tr_len_full):
+        def seg_body(si, carry):
+            st, stalled, calls0 = carry
+            tr_seg = jax.lax.dynamic_slice_in_dim(
+                tr_full, si * window, window, axis=1
+            )
+            tl_seg = jnp.clip(tr_len_full - si * window, 0, window)
+            st = {
+                **st,
+                "nsw": st["nsw"]
+                & ~(slsc["pc_mask"] << slsc["off_pc"]),
+            }
+            st["activeg"] = jax.lax.psum(
+                local_activity(st, tl_seg), "node"
+            )
+
+            # The quiescence gate must be uniform across the WHOLE mesh,
+            # not just the node axis: the exchange ppermutes inside the
+            # cycle are single program-wide collectives, so every device
+            # has to take the same number of while iterations even
+            # though each data row carries different systems.  One tiny
+            # pmax over "data" per k-cycle call (outside the cycle
+            # loop) makes the carried gate replicated; overshoot calls
+            # on an already-quiescent data row are value-no-ops.
+            def live(s2):
+                return (
+                    jax.lax.pmax(
+                        jnp.any(s2["activeg"] > 0).astype(jnp.int32),
+                        "data",
+                    )
+                    > 0
+                )
+
+            def cond(c):
+                s2, calls, go = c
+                return go & (calls < max_calls)
+
+            def body(c):
+                s2, calls, _ = c
+                full = {**s2, "tr": tr_seg, "tr_len": tl_seg}
+                full = jax.lax.fori_loop(
+                    0, k, lambda i, x: cycle(x), full
+                )
+                s2n = {f: full[f] for f in s2}
+                return s2n, calls + 1, live(s2n)
+
+            st, calls1, _ = jax.lax.while_loop(
+                cond, body, (st, calls0, live(st))
+            )
+            stalled = stalled | jnp.any(st["activeg"] > 0)
+            return st, stalled, calls1
+
+        state, stalled, _ = jax.lax.fori_loop(
+            0, n_seg, seg_body,
+            (dict(state), jnp.bool_(False), jnp.int32(0)),
+        )
+        overflow = jnp.any(state["scalars"][pe._SC_OVERFLOW] > 0)
+        exch = jnp.any(state["exchov"] > 0)
+        status = (
+            stalled.astype(jnp.int32)
+            | (overflow.astype(jnp.int32) << 1)
+            | (exch.astype(jnp.int32) << 2)
+        )
+        return state, status
+
+    return run_all
+
+
+@functools.lru_cache(maxsize=16)
+def build_node_sharded_pallas_run(
+    config: SystemConfig,
+    shard_b: int,
+    snapshots: bool,
+    window: int,
+    n_seg: int,
+    max_calls: int,
+    k: int,
+    mesh: Mesh,
+    exchange_slots: Optional[int] = None,
+    packed: bool = False,
+    interpret: bool = False,
+):
+    """The node-sharded whole-run program: the XLA interval body under
+    ``shard_map`` over the 2-D (data, node) mesh, while/fori loops per
+    shard (iteration counts agree across shards — the gate is the
+    replicated psum'd ``activeg``), one status word out."""
+    from hpa2_tpu.ops import pallas_engine as pe
+
+    node_shards = mesh.shape["node"]
+    run = _make_node_pallas_interval(
+        config, shard_b, snapshots, window, n_seg, max_calls, k,
+        node_shards, exchange_slots, packed,
+    )
+    shapes = pe.state_shapes(config, snapshots, packed)
+    state_sp = {
+        f: _node_plane_spec(f, len(sh) + 1) for f, sh in shapes.items()
+    }
+    for f in _PALLAS_TRANSIENTS:
+        state_sp[f] = P(None, "data")
+
+    def shard_body(state, tr, tr_len):
+        st, status = run(state, tr, tr_len)
+        return st, status[None]  # one status lane per data shard
+
+    wrapped = hostenv.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(state_sp, P("node", None, "data"), P("node", "data")),
+        out_specs=(state_sp, P("data")),
+        check_replication=False,
+    )
+
+    def run_all(state, tr, tr_len):
+        state, statuses = wrapped(state, tr, tr_len)
+        stalled = jnp.any((statuses & 1) != 0)
+        overflow = jnp.any((statuses & 2) != 0)
+        exch = jnp.any((statuses & 4) != 0)
+        return state, (
+            stalled.astype(jnp.int32)
+            | (overflow.astype(jnp.int32) << 1)
+            | (exch.astype(jnp.int32) << 2)
+        )
+
+    donate = () if interpret else (0,)
+    return jax.jit(run_all, donate_argnums=donate)
+
+
+@functools.lru_cache(maxsize=16)
+def build_node_fused_pallas_run(
+    config: SystemConfig,
+    r_shard: int,
+    bsys_shard: int,
+    k: int,
+    window: int,
+    nseg_max: int,
+    max_calls: int,
+    mesh: Mesh,
+    exchange_slots: Optional[int] = None,
+    packed: bool = False,
+    interpret: bool = False,
+):
+    """The fused scheduled run for the node-sharded path — the exact
+    scan/barrier structure of ``pallas_engine._make_fused_run`` rebuilt
+    around the node-sharded XLA interval body.  Differences forced by
+    the geometry: the admission-reset init is the INITIAL STATE OPERAND
+    (its memory plane differs per node row, so the host-side
+    ``_init_state`` closure of the single-chip builder — built at
+    global ``num_procs`` — cannot be captured per shard), and the
+    transient rows ride the scan carry untouched by the barrier
+    (``activeg`` is reseeded per interval; ``xmsgs``/``exchov`` are
+    whole-run accumulators, permutation-invariant under readback)."""
+    from hpa2_tpu.ops import pallas_engine as pe
+
+    node_shards = mesh.shape["node"]
+    raw = _make_node_pallas_interval(
+        config, r_shard, False, window, 1, max_calls, k, node_shards,
+        exchange_slots, packed,
+    )
+    shapes = pe.state_shapes(config, snapshots=False, packed=packed)
+    dtypes = pe.state_dtypes(config, snapshots=False, packed=packed)
+    fields = tuple(shapes)
+    nl = config.num_procs // node_shards
+
+    def loc_shape(f):
+        sh = tuple(shapes[f])
+        if f in ("scalars", "msg_counts"):
+            return sh
+        return (sh[0] // node_shards,) + sh[1:]
+
+    def shard_fused(state, tr_full, tr_len_full, sys, seg, perm, reset):
+        init = {f: state[f] for f in fields}  # t=0 state IS the init
+        trans = {
+            f: jnp.zeros((1, r_shard), jnp.int32)
+            for f in _PALLAS_TRANSIENTS
+        }
+        trf = jnp.transpose(
+            tr_full.reshape(nl, nseg_max, window, bsys_shard),
+            (1, 3, 0, 2),
+        ).reshape(nseg_max * bsys_shard, nl, window)
+        store = {
+            f: jnp.zeros(loc_shape(f) + (bsys_shard + 1,), dtypes[f])
+            for f in fields
+        }
+
+        def step(carry, xs):
+            st, tr_c, acc, status = carry
+            sys_i, seg_i, perm_i, reset_i = xs
+            st = {
+                f: jnp.where(
+                    reset_i != 0, init[f], jnp.take(v, perm_i, axis=-1)
+                )
+                for f, v in st.items()
+            }
+            sysc = jnp.clip(sys_i, 0, bsys_shard - 1)
+            gidx = jnp.clip(seg_i, 0, nseg_max - 1) * bsys_shard + sysc
+            tr_i = jnp.transpose(trf[gidx], (1, 2, 0))
+            tl_i = jnp.where(
+                sys_i >= 0,
+                jnp.clip(
+                    tr_len_full[:, sysc] - seg_i[None, :] * window,
+                    0, window,
+                ),
+                0,
+            )
+            full, s_int = raw({**st, **tr_c}, tr_i, tl_i)
+            st = {f: full[f] for f in fields}
+            tr_c = {f: full[f] for f in _PALLAS_TRANSIENTS}
+            tgt = jnp.where(sys_i >= 0, sys_i, bsys_shard)
+            acc = {f: acc[f].at[..., tgt].set(st[f]) for f in fields}
+            return (st, tr_c, acc, status | s_int), None
+
+        (st, trans, store, status), _ = jax.lax.scan(
+            step, ({f: state[f] for f in fields}, trans, store,
+                   jnp.int32(0)),
+            (sys, seg, perm, reset),
+        )
+        out = {f: store[f][..., :bsys_shard] for f in fields}
+        out.update(trans)
+        return out, status[None]
+
+    state_sp = {
+        f: _node_plane_spec(f, len(sh) + 1) for f, sh in shapes.items()
+    }
+    out_sp = dict(state_sp)
+    for f in _PALLAS_TRANSIENTS:
+        out_sp[f] = P(None, "data")
+    plan_sp = P(None, "data")
+
+    wrapped = hostenv.shard_map(
+        shard_fused,
+        mesh=mesh,
+        in_specs=(
+            state_sp, P("node", None, "data"), P("node", "data"),
+            plan_sp, plan_sp, plan_sp, plan_sp,
+        ),
+        out_specs=(out_sp, P("data")),
+        check_replication=False,
+    )
+
+    def run_all(state, tr, tr_len, sys, seg, perm, reset):
+        state, statuses = wrapped(state, tr, tr_len, sys, seg, perm,
+                                  reset)
+        stalled = jnp.any((statuses & 1) != 0)
+        overflow = jnp.any((statuses & 2) != 0)
+        exch = jnp.any((statuses & 4) != 0)
+        return state, (
+            stalled.astype(jnp.int32)
+            | (overflow.astype(jnp.int32) << 1)
+            | (exch.astype(jnp.int32) << 2)
+        )
+
+    donate = () if interpret else (0,)
+    return jax.jit(run_all, donate_argnums=donate)
+
+
+class NodeShardedPallasEngine(PallasEngine):
+    """The Pallas fast path with the NODE axis sharded over a device
+    mesh: one giant system (or a lane-sharded ensemble of them — 2-D
+    ``data x node`` mesh) whose per-node planes split into contiguous
+    node blocks, one block per device.
+
+    Phase C's cross-shard message delivery is the targeted exchange of
+    ``ops/exchange.py`` — ICI traffic proportional to the candidates
+    that actually cross shards (bounded by ``exchange_slots``), never a
+    per-cycle ``all_gather`` of the world.  The cycle program is the
+    same ``build_cycle`` body, built in sharded mode and run at the XLA
+    level under ``shard_map`` (collectives cannot live inside a Mosaic
+    kernel); results — dumps, snapshots, counters, stall semantics —
+    stay bit-identical to the single-device :class:`PallasEngine`,
+    including under the fused occupancy scheduler and packed planes.
+
+    ``exchange_slots`` caps the per-peer exchange buffer (default: the
+    capacity-exact ``5 * n_local``, which cannot overflow).  A tighter
+    cap reduces ICI bytes per cycle and trips a LOUD whole-run
+    :class:`StallError` on overflow — never a silent drop, because
+    acceptance is not determinable sender-side.
+    """
+
+    def __init__(
+        self,
+        config: SystemConfig,
+        tr_op: np.ndarray,
+        tr_addr: np.ndarray,
+        tr_val: np.ndarray,
+        tr_len: np.ndarray,
+        node_shards: Optional[int] = None,
+        data_shards: int = 1,
+        mesh: Optional[Mesh] = None,
+        exchange_slots: Optional[int] = None,
+        block: int = 1024,
+        **kwargs,
+    ):
+        if mesh is None:
+            if node_shards is None:
+                raise ValueError("pass node_shards or an explicit mesh")
+            mesh = make_mesh(
+                node_shards=node_shards, data_shards=data_shards
+            )
+        if tuple(mesh.axis_names) != ("data", "node"):
+            raise ValueError(
+                f"need a ('data', 'node') mesh, got {mesh.axis_names}"
+            )
+        node_shards = mesh.shape["node"]
+        data_shards = mesh.shape["data"]
+        if node_shards < 2:
+            raise ValueError(
+                "node_shards=1 is the unsharded fast path — use "
+                "PallasEngine / DataShardedPallasEngine"
+            )
+        if config.num_procs % node_shards != 0:
+            raise ValueError(
+                f"num_procs={config.num_procs} not divisible by node "
+                f"shards={node_shards}"
+            )
+        b = tr_op.shape[0]
+        if b % data_shards != 0:
+            raise ValueError(
+                f"batch {b} not divisible by data_shards={data_shards}"
+            )
+        sched = kwargs.get("schedule")
+        if sched is not None:
+            if not sched.fused:
+                raise NotImplementedError(
+                    "node-sharded Pallas supports the fused occupancy "
+                    "scheduler only (Schedule(fused=True)); the "
+                    "host-barrier loop would round-trip the sharded "
+                    "planes every interval"
+                )
+            resident = sched.resident or b
+            if resident % data_shards:
+                raise ValueError(
+                    f"schedule.resident={resident} not divisible by "
+                    f"data_shards={data_shards}"
+                )
+        # the fused plan's groups are data-shard-local, so the lane
+        # block must tile the per-shard lane count, not the full batch
+        if sched is not None:
+            block = choose_block(
+                (sched.resident or b) // data_shards, block
+            )
+        else:
+            block = choose_block(b // data_shards, block)
+        super().__init__(
+            config, tr_op, tr_addr, tr_val, tr_len, block=block, **kwargs
+        )
+        self.mesh = mesh
+        self.node_shards = node_shards
+        self.data_shards = data_shards
+        self._shard_b = b // data_shards
+        self._exchange_slots = exchange_slots
+        self._sched_groups = data_shards
+
+        def put(key, v):
+            return jax.device_put(
+                v, NamedSharding(mesh, _node_plane_spec(key, v.ndim))
+            )
+
+        self.state = {f: put(f, v) for f, v in self.state.items()}
+        for f in _PALLAS_TRANSIENTS:
+            self.state[f] = put(
+                f, jnp.zeros((1, b), jnp.int32)
+            )
+        self._tr_full = jax.device_put(
+            self._tr_full, NamedSharding(mesh, P("node", None, "data"))
+        )
+        self._tr_len_full = jax.device_put(
+            self._tr_len_full, NamedSharding(mesh, P("node", "data"))
+        )
+
+    @property
+    def cross_shard_msgs(self) -> int:
+        """Total exchange entries shipped across node shards over the
+        run (summed over lanes; candidates headed to multiple peers
+        count once per peer)."""
+        return int(np.sum(np.asarray(self.state["xmsgs"])))
+
+    def _runner(self, max_cycles: int):
+        max_calls = max(1, -(-max_cycles // self.cycles_per_call))
+        return build_node_sharded_pallas_run(
+            self.config, self._shard_b, self._snapshots, self._window,
+            self._n_seg, max_calls, self.cycles_per_call, self.mesh,
+            self._exchange_slots, self._packed, self._interpret,
+        )
+
+    def _fused_runner(self, max_cycles: int):
+        max_calls = max(1, -(-max_cycles // self.cycles_per_call))
+        return build_node_fused_pallas_run(
+            self.config, self._resident // self.data_shards,
+            self._shard_b, self.cycles_per_call, self._window,
+            self._n_seg, max_calls, self.mesh, self._exchange_slots,
+            self._packed, self._interpret,
+        )
+
+    def _fused_plan_arrays(self, plan):
+        # identical rebasing to DataShardedPallasEngine: groups are
+        # data-shard-local, so system/permutation indices localize to
+        # each shard's contiguous slice; plan rows replicate over node
+        shards = self.data_shards
+        gl = self._resident // shards
+        gs = self.b // shards
+        g = np.arange(self._resident, dtype=np.int64) // gl
+        sys_l = np.where(plan.sys >= 0, plan.sys - g[None, :] * gs, -1)
+        perm_l = plan.perm - g[None, :] * gl
+        put = lambda x: jax.device_put(
+            jnp.asarray(x), NamedSharding(self.mesh, P(None, "data"))
+        )
+        return (
+            put(sys_l.astype(np.int32)),
+            put(plan.seg),
+            put(perm_l.astype(np.int32)),
+            put(plan.reset.astype(np.int32)),
+        )
+
+    def _sched_put(self, x):
+        # only reached for the fused initial state (fused=False raises
+        # in the ctor); keyless, so infer the plane class by leading
+        # axis — every node-leading plane starts with num_procs rows,
+        # and no replicated plane does (scalars/msg_counts rows are
+        # enum-sized)
+        from hpa2_tpu.ops import pallas_engine as pe
+
+        lead = x.shape[0] if x.ndim else 0
+        if x.ndim >= 2 and lead == self.config.num_procs:
+            spec = P("node", *([None] * (x.ndim - 2)), "data")
+        else:
+            spec = P(*([None] * (x.ndim - 1)), "data")
+        return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+    def _check_status(self, status: int, max_cycles: int) -> None:
+        if status & 4:
+            self._poisoned = True
+            raise StallError(
+                "cross-shard exchange overflow: a cycle had more "
+                "out-bound candidates for one peer shard than "
+                f"exchange_slots={self._exchange_slots}; raise it (the "
+                "capacity-exact default never overflows)"
+            )
+        super()._check_status(status, max_cycles)

@@ -39,6 +39,13 @@ Steps, in value order:
                      check (scripts/scale_runs.py multichip), which
                      writes MULTICHIP_r06.json with indicative:true
                      pod-slice numbers
+ 14. nodeshard     — PR-7 node-axis sharding: one system split across
+                     4 chips vs the same system on one chip (final
+                     dumps bit-exactness gate + measured cross-shard
+                     ICI traffic), then the node_shards ladder
+                     (scripts/scale_runs.py nodeshard →
+                     MULTICHIP_r07.json) and a sharded-only 4096-node
+                     geometry no single chip fits
 
 All measure() steps run the HBM-streaming run program (PallasEngine
 default stream=True since the VMEM-wall PR).
@@ -281,6 +288,77 @@ def measure_fused_occupancy_child(params) -> int:
     return 0 if exact5 and exactf else 1
 
 
+def measure_nodeshard_child(params) -> int:
+    """--measure-nodeshard mode: one system's node planes split over
+    ``shards`` devices (NodeShardedPallasEngine, targeted ppermute
+    exchange), timed, with the measured cross-shard traffic.  With
+    ``compare=1`` the same workload also runs on the single-chip
+    kernel and the whole state must be bit-exact (nonzero exit
+    otherwise); ``compare=0`` is for geometries one chip cannot hold.
+    Params: procs batch instrs block k cap window gate shards compare.
+    """
+    import numpy as np
+
+    from hpa2_tpu.config import Semantics, SystemConfig
+    from hpa2_tpu.ops.pallas_engine import PallasEngine
+    from hpa2_tpu.parallel.sharding import NodeShardedPallasEngine
+    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+    (procs, batch, instrs, block, k, cap, window, gate, shards,
+     compare) = params[:10]
+    config = SystemConfig(num_procs=procs, msg_buffer_size=cap,
+                          max_instr_num=0,
+                          semantics=Semantics().robust())
+    arrays = gen_uniform_random_arrays(config, batch, instrs, seed=0)
+    kw = dict(block=block, cycles_per_call=k, snapshots=False,
+              trace_window=window, gate=bool(gate))
+
+    def timed(build):
+        eng = build()
+        t0 = time.perf_counter()
+        eng.run(max_cycles=5_000_000)
+        return eng, time.perf_counter() - t0
+
+    def mk_sharded():
+        return NodeShardedPallasEngine(
+            config, *arrays, node_shards=shards, **kw)
+
+    timed(mk_sharded)  # compile + warm
+    shd, shd_dt = timed(mk_sharded)
+    xmsgs = shd.cross_shard_msgs
+    rec = {
+        "procs": procs, "batch": batch, "instrs": instrs,
+        "block": block, "k": k, "cap": cap, "window": window,
+        "gate": gate, "node_shards": shards,
+        "instructions": shd.instructions, "cycles": shd.cycle,
+        "sharded_s": round(shd_dt, 3),
+        "ops_per_sec": round(shd.instructions / shd_dt, 1),
+        "cross_shard_msgs": xmsgs,
+        "cross_shard_msgs_per_cycle": round(
+            xmsgs / max(shd.cycle, 1), 2),
+        "ppermutes_per_cycle": 2 * (shards - 1),
+    }
+    exact = True
+    if compare:
+        def mk_single():
+            return PallasEngine(config, *arrays, **kw)
+
+        timed(mk_single)
+        ref, ref_dt = timed(mk_single)
+        exact = all(
+            np.array_equal(np.asarray(v), np.asarray(shd.state[f]))
+            for f, v in ref.state.items()
+        )
+        rec.update(
+            single_chip_s=round(ref_dt, 3),
+            sharded_over_single=round(ref_dt / shd_dt, 2)
+            if shd_dt else None,
+            bit_exact=exact,
+        )
+    print(json.dumps(rec))
+    return 0 if exact else 1
+
+
 def measure(step, batch, instrs, block, k, cap, window, gate,
             timeout_s=900, shards=1):
     params = [batch, instrs, block, k, cap, window, gate]
@@ -367,6 +445,10 @@ def main() -> int:
     if sys.argv[1:2] == ["--measure-fused-occupancy"]:
         return measure_fused_occupancy_child(
             [int(x) for x in sys.argv[2:11]]
+        )
+    if sys.argv[1:2] == ["--measure-nodeshard"]:
+        return measure_nodeshard_child(
+            [int(x) for x in sys.argv[2:12]]
         )
     session_start = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     skip = set()
@@ -503,6 +585,35 @@ def main() -> int:
             [os.path.join(REPO, "scripts", "scale_runs.py"),
              "multichip"],
             timeout_s=1800, argv=True))
+
+    if "nodeshard" not in skip and gate("nodeshard"):
+        # PR-7: a 64-node system split across 4 chips vs the same
+        # system on one chip — bit-exactness gates the step, and the
+        # measured cross-shard traffic is the ICI cost the targeted
+        # exchange actually pays (the all_gather it replaced shipped
+        # the whole candidate grid every cycle)
+        note(run_py(
+            "nodeshard",
+            [os.path.abspath(__file__), "--measure-nodeshard",
+             "64", "1024", "64", "512", "64", "16", "16", "0",
+             "4", "1"],
+            timeout_s=1800, argv=True))
+        # the node_shards ladder (rewrites MULTICHIP_r07.json with
+        # indicative:true numbers)
+        note(run_py(
+            "nodeshard_ladder",
+            [os.path.join(REPO, "scripts", "scale_runs.py"),
+             "nodeshard"],
+            timeout_s=1800, argv=True))
+        # the geometry the node axis exists for: 4096 simulated nodes,
+        # more than one chip holds — sharded-only, no single-chip
+        # reference (compare=0)
+        note(run_py(
+            "nodeshard4096",
+            [os.path.abspath(__file__), "--measure-nodeshard",
+             "4096", "8", "32", "8", "64", "16", "16", "0",
+             "4", "0"],
+            timeout_s=2400, argv=True))
     return 0
 
 

@@ -52,7 +52,33 @@ then
   echo "TIER1: packed+fused smoke failed" >&2
   exit 1
 fi
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+# Node-shard smoke (~30s, virtual 2x2 mesh): the ISSUE-7 fast path —
+# one system's node planes split over the mesh's node axis with the
+# targeted ppermute exchange, composed with data sharding — must stay
+# bit-exact against the single-chip jax engine's dumps and actually
+# ship cross-shard traffic.  Catches exchange wiring breaks cheaply.
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python - > /dev/null <<'EOF'
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.ops.engine import JaxEngine
+from hpa2_tpu.parallel.sharding import NodeShardedPallasEngine
+from hpa2_tpu.utils.trace import gen_uniform_random, traces_to_arrays
+
+cfg = SystemConfig(num_procs=8, semantics=Semantics().robust())
+batch = [gen_uniform_random(cfg, 10, seed=60 + s) for s in range(2)]
+eng = NodeShardedPallasEngine(
+    cfg, *traces_to_arrays(cfg, batch), node_shards=2, data_shards=2,
+    snapshots=False, cycles_per_call=16).run()
+assert eng.cross_shard_msgs > 0
+for s, traces in enumerate(batch):
+    ref = JaxEngine(cfg, traces).run()
+    assert [d.__dict__ for d in eng.system_final_dumps(s)] == [
+        d.__dict__ for d in ref.final_dumps()], f"system {s} diverged"
+EOF
+then
+  echo "TIER1: node-shard smoke failed" >&2
+  exit 1
+fi
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

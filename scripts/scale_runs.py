@@ -16,6 +16,14 @@ multi-device ensemble-scaling ladder.
              numbers ``indicative: false`` (virtual devices share the
              host's cores; only the partition evidence transfers, the
              wall-clock does not).
+  nodeshard: the node_shards ladder (1..min(num_procs, devices)) on
+             one fixed workload — each system's node planes split
+             over the mesh's ``node`` axis with the targeted
+             cross-shard exchange — with a bit-exactness check
+             against the single-device run and the measured
+             cross-shard message rate per rung; writes
+             MULTICHIP_r07.json (same CPU virtual-mesh conventions
+             as ``multichip``).
 
 Prints one JSON line per config for PERF.md.
 """
@@ -27,6 +35,7 @@ import time
 sys.path.insert(0, "/root/repo")
 
 _MULTICHIP_PATH = "/root/repo/MULTICHIP_r06.json"
+_NODESHARD_PATH = "/root/repo/MULTICHIP_r07.json"
 
 
 def config4(instrs_per_core=4096):
@@ -223,6 +232,112 @@ def multichip(batch=32, instrs_per_core=32):
     assert bit_exact, "sharded run diverged from single-device state"
 
 
+def nodeshard(batch=4, instrs_per_core=16):
+    """The node_shards scaling ladder for MULTICHIP_r07.json: one
+    fixed workload, node planes split over 1/2/4/... devices, final
+    state bit-exact vs the single-device kernel at every rung, plus
+    the measured cross-shard traffic (the ICI bytes the targeted
+    exchange actually ships — the all_gather it replaced moved the
+    whole candidate grid every cycle).
+
+    Same conventions as ``multichip``: on CPU the virtual 8-device
+    mesh proves structure, not wall-clock (``indicative: false``),
+    and interpret mode keeps the CPU workload tiny.
+    """
+    import jax
+    import numpy as np
+
+    from hpa2_tpu.config import Semantics, SystemConfig
+    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+    platform = jax.devices()[0].platform
+    on_tpu = any("tpu" in str(d).lower() for d in jax.devices())
+    n_dev = len(jax.devices())
+    if not on_tpu and n_dev < 8:
+        from hpa2_tpu.hostenv import reexec_with_virtual_mesh
+
+        reexec_with_virtual_mesh(8)
+    num_procs = 8
+    if on_tpu:
+        # one system bigger than a chip is the point: more nodes,
+        # fewer lanes than the ensemble ladder
+        num_procs, batch, instrs_per_core = 64, 1024, 64
+    config = SystemConfig(
+        num_procs=num_procs, msg_buffer_size=16, max_instr_num=0,
+        semantics=Semantics().robust(),
+    )
+    arrays = gen_uniform_random_arrays(config, batch, instrs_per_core)
+    kw = dict(block=512, cycles_per_call=64, snapshots=False,
+              trace_window=16)
+
+    def build(shards):
+        if shards == 1:
+            from hpa2_tpu.ops.pallas_engine import PallasEngine
+
+            return PallasEngine(config, *arrays, **kw)
+        from hpa2_tpu.parallel.sharding import NodeShardedPallasEngine
+
+        return NodeShardedPallasEngine(
+            config, *arrays, node_shards=shards, **kw)
+
+    ladder = [
+        s for s in (1, 2, 4, 8, 16, 32)
+        if s <= min(n_dev, num_procs)
+    ]
+    rows = []
+    ref_state = None
+    bit_exact = True
+    for shards in ladder:
+        build(shards).run(max_cycles=5_000_000)  # compile + warm
+        eng = build(shards)
+        t0 = time.perf_counter()
+        eng.run(max_cycles=5_000_000)
+        dt = time.perf_counter() - t0
+        if ref_state is None:
+            ref_state = {f: np.asarray(v) for f, v in eng.state.items()}
+        else:
+            # the sharded engine carries extra transient planes
+            # (activeg/xmsgs/exchov); compare the architectural ones
+            bit_exact = bit_exact and all(
+                np.array_equal(v, np.asarray(eng.state[f]))
+                for f, v in ref_state.items()
+            )
+        row = {
+            "node_shards": shards,
+            "instructions": eng.instructions,
+            "cycles": eng.cycle,
+            "seconds": round(dt, 3),
+            "ops_per_sec": round(eng.instructions / dt, 1),
+        }
+        if shards > 1:
+            xmsgs = eng.cross_shard_msgs
+            row["cross_shard_msgs"] = xmsgs
+            row["cross_shard_msgs_per_cycle"] = round(
+                xmsgs / max(eng.cycle, 1), 2)
+            row["ppermutes_per_cycle"] = 2 * (shards - 1)
+        rows.append(row)
+        print(json.dumps({"nodeshard_step": row}), flush=True)
+
+    record = {
+        "metric": "pallas_node_shard_scaling",
+        "unit": "RD/WR ops/sec",
+        "platform": platform,
+        "n_devices": n_dev,
+        "indicative": on_tpu,
+        "nodes": num_procs,
+        "batch": batch,
+        "instrs_per_core": instrs_per_core,
+        "bit_exact_vs_single_device": bool(bit_exact),
+        "shards": rows,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(_NODESHARD_PATH, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print(json.dumps(record), flush=True)
+    assert bit_exact, "node-sharded run diverged from single-device state"
+
+
 def _arg_int(name, default):
     if name in sys.argv:
         return int(sys.argv[sys.argv.index(name) + 1])
@@ -233,6 +348,9 @@ if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "both"
     if which == "multichip":
         multichip()
+        sys.exit(0)
+    if which == "nodeshard":
+        nodeshard()
         sys.exit(0)
     shards = _arg_int("--data-shards", 1)
     if which in ("4", "both"):

@@ -87,7 +87,7 @@ def test_bench_grid_sharded_json(capsys):
 
 
 def test_shard_flags_rejected_for_non_jax():
-    with pytest.raises(SystemExit, match="jax-backend"):
+    with pytest.raises(SystemExit, match="jax/pallas-backend"):
         main([
             "bench", "--backend", "omp", "--node-shards", "2",
             "--instrs", "8",

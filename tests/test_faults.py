@@ -318,8 +318,10 @@ def test_cli_rejects_fault_on_unsupported_backends(tmp_path):
         main(["run", "x", "--backend", "pallas", "--fault-drop", "0.1"])
     with pytest.raises(SystemExit):
         main(["bench", "--backend", "omp", "--fault-drop", "0.1"])
+    # jax + --node-shards now supports faults (the link-layer PRNG
+    # folds the shard index in); pallas still has no fault model
     with pytest.raises(SystemExit):
-        main(["run", "x", "--backend", "jax", "--fault-drop", "0.1",
+        main(["run", "x", "--backend", "pallas", "--fault-drop", "0.1",
               "--node-shards", "2"])
 
 
@@ -405,3 +407,48 @@ def test_batch_watchdog_diag_identical_across_sharding():
         ref.run()
     for f in _DIAG_FIELDS[1:]:
         assert getattr(ei.value, f) == getattr(d8, f)
+
+
+# -- node-sharded faults ----------------------------------------------
+#
+# Node sharding splits ONE faulty system across devices, so the
+# link-layer PRNG folds the shard index into its mask keys: each shard
+# draws an independent stream and the injected faults differ from the
+# unsharded run.  The invariant that survives any partition is
+# *masking* — the retry layer must hide every fault, so final dumps
+# are byte-identical to the fault-free golden whatever the mesh.
+
+
+@pytest.mark.virtual_mesh
+@pytest.mark.parametrize("node_shards", [2, 4])
+def test_node_sharded_faults_masked(node_shards):
+    import jax
+
+    from hpa2_tpu.parallel.sharding import NodeShardedEngine, make_mesh
+
+    if len(jax.devices()) < node_shards:
+        pytest.skip(f"needs {node_shards} devices")
+    cfg0 = SystemConfig(num_procs=8, semantics=ROBUST)
+    traces = gen_uniform_random(cfg0, 20, seed=6)
+    golden = _golden(cfg0, traces)
+
+    cfg = dataclasses.replace(cfg0, fault=FaultModel(**ACCEPT))
+    eng = NodeShardedEngine(
+        cfg, traces, mesh=make_mesh(node_shards=node_shards)
+    ).run()
+    assert _dicts(eng.final_dumps()) == golden
+    assert check_invariants(eng.final_dumps(), cfg) == []
+    # ... and therefore identical to the unsharded faulty run's dumps
+    # (each is masked down to the same golden)
+    from hpa2_tpu.ops.engine import JaxEngine
+
+    jx = JaxEngine(cfg, traces).run()
+    assert _dicts(eng.final_dumps()) == _dicts(jx.final_dumps())
+    assert jx.stats()["fault_retransmissions"] > 0
+    # faults actually crossed the targeted exchange and were masked,
+    # not avoided
+    assert eng.stats()["fault_retransmissions"] > 0
+    # schedule untouched: same wall-cycles as the fault-free run
+    ref = SpecEngine(cfg0, traces)
+    ref.run()
+    assert eng.cycle == ref.cycle

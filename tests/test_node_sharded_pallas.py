@@ -1,0 +1,314 @@
+"""Node-axis sharding for the Pallas fast path: one system's node
+planes split into contiguous blocks over the mesh's ``node`` axis,
+with phase-C delivery running as the targeted cross-shard exchange
+(ops/exchange.py) at the XLA level.
+
+Everything here must be *bit-identical* to the single-chip engines —
+same state planes, same counters, same per-node dumps — and the cycle
+loop must contain only the exchange collectives: ``2*(D-1)`` ppermutes
+plus ONE stacked counter psum per cycle, no per-cycle ``all_gather``.
+
+Runs on the virtual 8-device CPU mesh from conftest.  The interpret-
+mode single-chip references dominate the wall clock, so they are
+shared across tests via module-level caches.
+"""
+
+import functools
+
+import jax
+import numpy as np
+import pytest
+
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.models.spec_engine import StallError
+from hpa2_tpu.ops.engine import JaxEngine
+from hpa2_tpu.ops.pallas_engine import PallasEngine
+from hpa2_tpu.ops.schedule import Schedule
+from hpa2_tpu.parallel.sharding import (
+    NodeShardedEngine,
+    NodeShardedPallasEngine,
+    make_mesh,
+)
+from hpa2_tpu.utils.trace import (
+    gen_uniform_random,
+    gen_uniform_random_arrays,
+    traces_to_arrays,
+)
+
+pytestmark = pytest.mark.virtual_mesh
+
+ROBUST = Semantics().robust()
+
+
+def _require_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+
+
+def _cfg(n=8):
+    return SystemConfig(num_procs=n, semantics=ROBUST)
+
+
+@functools.lru_cache(maxsize=None)
+def _arrays(n=8, bb=4, t=12, seed=1):
+    return gen_uniform_random_arrays(_cfg(n), bb, t, seed=seed)
+
+
+@functools.lru_cache(maxsize=None)
+def _ref(n=8, bb=4, t=12, seed=1, snapshots=True):
+    """The single-chip interpret-mode reference (expensive: interpret
+    runs the whole kernel through the Pallas evaluator)."""
+    return PallasEngine(
+        _cfg(n), *_arrays(n, bb, t, seed), interpret=True,
+        block=max(bb // 2, 1), snapshots=snapshots,
+    ).run()
+
+
+def _assert_bit_exact(shd, ref):
+    for f, v in ref.state.items():
+        assert np.array_equal(np.asarray(v), np.asarray(shd.state[f])), (
+            f"state plane {f!r} diverged under node sharding"
+        )
+    assert shd.cycle == ref.cycle
+    assert shd.instructions == ref.instructions
+    assert shd.messages == ref.messages
+    assert shd.stats() == ref.stats()
+    for s in range(ref.b):
+        assert [d.__dict__ for d in shd.system_final_dumps(s)] == [
+            d.__dict__ for d in ref.system_final_dumps(s)
+        ], f"node dumps diverged for system {s}"
+
+
+# -- bit-exactness vs the single-chip kernel --------------------------
+
+
+@pytest.mark.parametrize(
+    "node_shards,data_shards", [(2, 1), (4, 2)],
+    ids=["1x2", "2x4"],
+)
+def test_bit_exact_vs_single_device(node_shards, data_shards):
+    """data x node mesh, snapshots ON: every plane (including the
+    snapshot planes) and every per-node dump byte-identical."""
+    _require_devices(node_shards * data_shards)
+    ref = _ref()
+    shd = NodeShardedPallasEngine(
+        _cfg(), *_arrays(), node_shards=node_shards,
+        data_shards=data_shards, cycles_per_call=16,
+    ).run()
+    assert shd.node_shards == node_shards
+    assert shd.data_shards == data_shards
+    _assert_bit_exact(shd, ref)
+    assert shd.cross_shard_msgs > 0, (
+        "uniform-random traffic must cross shards"
+    )
+
+
+def test_bit_exact_4x2_mesh_snapshots_off():
+    """The transposed mesh (data_shards=4, node_shards=2) without
+    snapshot planes."""
+    _require_devices(8)
+    ref = _ref(snapshots=False)
+    shd = NodeShardedPallasEngine(
+        _cfg(), *_arrays(), node_shards=2, data_shards=4,
+        snapshots=False, cycles_per_call=16,
+    ).run()
+    _assert_bit_exact(shd, ref)
+
+
+def test_bit_exact_split_plane_22_nodes():
+    """num_procs=22 > 21 flips the sharer planes into split multi-word
+    mode; the exchange masks/feedback are per-word.  Cross-backend:
+    the jax lockstep engine is the reference for the dumps."""
+    _require_devices(2)
+    cfg = _cfg(22)
+    batch = [gen_uniform_random(cfg, 10, seed=40 + s) for s in range(2)]
+    shd = NodeShardedPallasEngine(
+        cfg, *traces_to_arrays(cfg, batch), node_shards=2,
+        snapshots=False, cycles_per_call=16,
+    ).run()
+    for s, traces in enumerate(batch):
+        ref = JaxEngine(cfg, traces).run()
+        assert [d.__dict__ for d in shd.system_final_dumps(s)] == [
+            d.__dict__ for d in ref.final_dumps()
+        ], f"dumps diverged vs jax engine for system {s}"
+
+
+def test_cross_backend_dumps_vs_jax_and_node_sharded():
+    """The sharded Pallas path, the single-chip jax engine and the
+    node-sharded jax engine (ops/step.py exchange retrofit) all agree
+    on the final per-node dumps."""
+    _require_devices(8)
+    cfg = _cfg()
+    traces = gen_uniform_random(cfg, 12, seed=7)
+    shd = NodeShardedPallasEngine(
+        cfg, *traces_to_arrays(cfg, [traces]), node_shards=4,
+        snapshots=False, cycles_per_call=16,
+    ).run()
+    jx = JaxEngine(cfg, traces).run()
+    nsx = NodeShardedEngine(
+        cfg, traces, mesh=make_mesh(node_shards=4)
+    ).run()
+    want = [d.__dict__ for d in jx.final_dumps()]
+    assert [d.__dict__ for d in shd.system_final_dumps(0)] == want
+    assert [d.__dict__ for d in nsx.final_dumps()] == want
+
+
+# -- fused occupancy scheduler / packed planes ------------------------
+
+
+@pytest.mark.parametrize("packed", [False, True], ids=["i32", "packed"])
+def test_fused_schedule_bit_exact(packed):
+    """The fused occupancy scheduler under node sharding: the sharded
+    scheduled run must reproduce the sharded unscheduled run's dumps
+    (which test_bit_exact_* pins to the single-chip engine)."""
+    _require_devices(8)
+    cfg = _cfg()
+    arrays = _arrays()
+    kw = dict(snapshots=False, cycles_per_call=16, trace_window=8)
+    plain = NodeShardedPallasEngine(
+        cfg, *arrays, node_shards=4, data_shards=2, **kw
+    ).run()
+    fused = NodeShardedPallasEngine(
+        cfg, *arrays, node_shards=4, data_shards=2,
+        schedule=Schedule(), packed=packed, **kw
+    ).run()
+    assert fused.occupancy.device_programs == 1
+    assert fused.occupancy.host_barriers == 0
+    for s in range(plain.b):
+        assert [d.__dict__ for d in fused.system_final_dumps(s)] == [
+            d.__dict__ for d in plain.system_final_dumps(s)
+        ], f"fused dumps diverged for system {s}"
+    assert fused.instructions == plain.instructions
+
+
+# -- exchange buffer sizing -------------------------------------------
+
+
+def test_exchange_slots_overflow_is_loud():
+    """A too-small per-peer exchange buffer must fail the whole run
+    with a StallError, never drop messages silently."""
+    _require_devices(2)
+    eng = NodeShardedPallasEngine(
+        _cfg(), *_arrays(), node_shards=2, exchange_slots=1,
+        cycles_per_call=16,
+    )
+    with pytest.raises(StallError, match="exchange overflow"):
+        eng.run()
+
+
+# -- geometry validation ----------------------------------------------
+
+
+def test_geometry_validation():
+    _require_devices(4)
+    cfg = _cfg()
+    arrays = _arrays()
+    with pytest.raises(ValueError, match="not divisible by node"):
+        NodeShardedPallasEngine(cfg, *arrays, node_shards=3)
+    with pytest.raises(ValueError, match="data_shards"):
+        NodeShardedPallasEngine(
+            cfg, *arrays, node_shards=2, data_shards=3
+        )
+    with pytest.raises(ValueError, match="unsharded fast path"):
+        NodeShardedPallasEngine(cfg, *arrays, node_shards=1)
+    with pytest.raises(NotImplementedError, match="fused"):
+        NodeShardedPallasEngine(
+            cfg, *arrays, node_shards=2,
+            schedule=Schedule(fused=False),
+        )
+
+
+# -- collective-count guards (jaxpr layer) ----------------------------
+#
+# The whole point of the targeted exchange: the cycle loop carries
+# exactly 2*(D-1) ppermutes (forward buffers + acceptance feedback)
+# plus ONE stacked counter psum, and never an all_gather.  Counting
+# primitives in the traced program pins this — a regression to
+# gather-the-world delivery shows up as all_gather > 0 or a changed
+# ppermute count.
+
+
+def _subvalues(eqn):
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (list, tuple)) else (v,)
+        for x in vs:
+            if hasattr(x, "jaxpr"):
+                yield x.jaxpr
+            elif hasattr(x, "eqns"):
+                yield x
+
+
+def _find_subjaxprs(jaxpr, prim_name):
+    found = []
+    for eqn in jaxpr.eqns:
+        subs = list(_subvalues(eqn))
+        if eqn.primitive.name == prim_name:
+            found += subs
+        else:
+            for sub in subs:
+                found += _find_subjaxprs(sub, prim_name)
+    return found
+
+
+def _count_prims(jaxpr, names):
+    n = sum(1 for eqn in jaxpr.eqns if eqn.primitive.name in names)
+    for eqn in jaxpr.eqns:
+        for sub in _subvalues(eqn):
+            n += _count_prims(sub, names)
+    return n
+
+
+_PSUM_PRIMS = ("psum", "psum2", "psum_invariant")
+_GATHER_PRIMS = ("all_gather", "all_to_all", "all_gather_invariant")
+
+
+@pytest.mark.parametrize("node_shards", [2, 4])
+def test_cycle_loop_collectives_pinned(node_shards):
+    _require_devices(node_shards)
+    eng = NodeShardedPallasEngine(
+        _cfg(), *_arrays(), node_shards=node_shards,
+        cycles_per_call=16,
+    )
+    jx = jax.make_jaxpr(eng._runner(10_000))(
+        eng.state, eng._tr_full, eng._tr_len_full
+    ).jaxpr
+    bodies = _find_subjaxprs(jx, "shard_map")
+    assert bodies, "node-sharded runner lost its shard_map"
+    n_permute = sum(_count_prims(b, ("ppermute",)) for b in bodies)
+    n_psum = sum(_count_prims(b, _PSUM_PRIMS) for b in bodies)
+    n_pmax = sum(_count_prims(b, ("pmax",)) for b in bodies)
+    n_gather = sum(_count_prims(b, _GATHER_PRIMS) for b in bodies)
+    assert n_permute == 2 * (node_shards - 1), (
+        f"cycle must ship {2 * (node_shards - 1)} ppermutes "
+        f"(fwd + feedback per peer round), found {n_permute}"
+    )
+    # one stacked counter/quiescence psum in the cycle + the per-
+    # segment activity seed psum outside the cycle loop
+    assert n_psum == 2, f"expected cycle psum + seed psum, got {n_psum}"
+    # the whole-mesh loop gate: one pmax per k-cycle call, outside the
+    # cycle loop (traced twice: the while seed and the loop body)
+    assert n_pmax == 2, f"expected seed + per-call loop-gate pmax, got {n_pmax}"
+    assert n_gather == 0, (
+        f"{n_gather} gather-the-world collective(s) crept back into "
+        "the node-sharded run program"
+    )
+
+
+def test_jax_step_collectives_pinned():
+    """Same pin for the retrofitted ops/step.py path: the sharded step
+    function carries 2*(D-1) ppermutes + 1 psum, no all_gather."""
+    _require_devices(4)
+    cfg = _cfg()
+    traces = gen_uniform_random(cfg, 12, seed=7)
+    eng = NodeShardedEngine(
+        cfg, traces, mesh=make_mesh(node_shards=4)
+    )
+    jx = jax.make_jaxpr(eng._run)(eng.state).jaxpr
+    bodies = _find_subjaxprs(jx, "shard_map")
+    assert bodies, "node-sharded jax run lost its shard_map"
+    n_permute = sum(_count_prims(b, ("ppermute",)) for b in bodies)
+    n_psum = sum(_count_prims(b, _PSUM_PRIMS) for b in bodies)
+    n_gather = sum(_count_prims(b, _GATHER_PRIMS) for b in bodies)
+    assert n_permute == 2 * 3
+    assert n_psum == 1
+    assert n_gather == 0

@@ -1,7 +1,8 @@
 """Multi-chip sharding: the node-sharded / grid engines must be
 bit-identical to the single-chip JAX engine (same cycles, counters,
-snapshots) — delivery order is preserved across the all_gather
-(ops/step.py phase C; SURVEY.md §2.4).
+snapshots) — delivery order is preserved across the targeted
+cross-shard exchange (ops/step.py phase C via ops/exchange.py;
+SURVEY.md §2.4).
 
 Runs on the virtual 8-device CPU mesh from conftest.
 """

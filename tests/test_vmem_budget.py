@@ -129,6 +129,46 @@ class TestPackedPlanes:
         assert bud.total_bytes == bud.total_rows * 1024 * 4
 
 
+class TestNodeSharding:
+    """ISSUE 7 acceptance: the model at ``node_shards=N`` reports the
+    per-shard geometry (num_procs/N local nodes), and the max-fitting
+    block at least doubles from 1 -> 2 shards on the bench shape."""
+
+    def test_per_shard_rows_mirror_plane_shapes(self):
+        from hpa2_tpu.analysis.vmem import _plane_rows
+
+        cfg = _bench_config()
+        full = _plane_rows(cfg, snapshots=False)
+        half = _plane_rows(cfg, snapshots=False, node_shards=2)
+        for f, r in full.items():
+            if f in ("scalars", "msg_counts"):
+                assert half[f] == r, f"{f} is replicated, not sharded"
+            else:
+                assert half[f] == r // 2, (
+                    f"{f} must carry half its rows per shard"
+                )
+
+    def test_max_fitting_block_doubles_at_2_shards(self):
+        from hpa2_tpu.analysis.vmem import max_fitting_block
+
+        cfg = _bench_config()
+        one = max_fitting_block(cfg, 32)
+        two = max_fitting_block(cfg, 32, node_shards=2)
+        assert two >= 2 * one, (
+            f"node sharding must at least double the block ladder's "
+            f"top rung: {one} -> {two}"
+        )
+
+    def test_nondividing_geometry_raises(self):
+        with pytest.raises(ValueError, match="must divide"):
+            vmem_budget(_bench_config(), 512, 32, node_shards=3)
+
+    def test_table_reports_shard_geometry(self):
+        out = budget_table(_bench_config(), node_shards=2)
+        assert "node_shards=2" in out and "4 local nodes/shard" in out
+        assert "max fitting block" in out
+
+
 class TestHotLoopGuards:
     def _cycle_ops(self, snapshots):
         cfg = _bench_config()
