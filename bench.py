@@ -243,10 +243,23 @@ def bench_pallas(config, batch, instrs_per_core, seed=0, data_shards=1,
                                 trace_window=window, gate=gate, **extra)
 
     build().run()  # compile + warmup
+    # measured run, phase-split: host staging (trace gen is done above;
+    # this is packing + device_put of the ensemble planes), device
+    # execution, and the counter readback sync
+    t0 = time.perf_counter()
     eng = build()
+    stage_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     eng.run()
     dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    instrs = eng.instructions
+    read_s = time.perf_counter() - t0
+    phases = {
+        "host_staging_s": round(stage_s, 4),
+        "device_execute_s": round(dt, 4),
+        "readback_s": round(read_s, 4),
+    }
     exchange = None
     if node_shards > 1:
         xmsgs = eng.cross_shard_msgs
@@ -267,7 +280,7 @@ def bench_pallas(config, batch, instrs_per_core, seed=0, data_shards=1,
         # bit-identical either way, only the launch accounting
         # (host_barriers/device_programs) differs
         occupancy = eng.occupancy.as_dict()
-    return eng.instructions, dt, occupancy, exchange
+    return instrs, dt, occupancy, exchange, phases
 
 
 def bench_jax(config, batch, instrs_per_core, seed=0):
@@ -331,9 +344,10 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
     ran_ok = False
     occupancy = None
     exchange = None
+    phases = None
     if pallas_ok or not on_tpu:  # CPU always tries interpret mode
         try:
-            jax_instrs, jax_dt, occupancy, exchange = bench_pallas(
+            jax_instrs, jax_dt, occupancy, exchange, phases = bench_pallas(
                 config, batch, instrs_per_core, data_shards=shards,
                 node_shards=node_shards, dist=dist, spread=spread,
                 packed=packed, schedule_resident=resident, fused=fused)
@@ -375,6 +389,8 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
         result["trace_len_dist"] = {"dist": dist, "spread": spread}
     if occupancy is not None:
         result["occupancy"] = occupancy
+    if phases is not None:
+        result["phases"] = phases
     if shards != 1:
         import jax
 
@@ -436,6 +452,122 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
         )
     except Exception as e:  # optional context only — never fatal
         result["native_lockstep_note"] = f"lockstep context failed: {e}"
+    print(json.dumps(result))
+    return 0
+
+
+def _serve_knobs(on_tpu: bool):
+    """Serving-bench geometry, overridable via HPA2_SERVE_* env vars
+    (the measurement session's serve512 step scales these up without a
+    code edit)."""
+
+    def _int(name, default):
+        try:
+            return int(os.environ.get(name, str(default)))
+        except ValueError:
+            return default
+
+    resident = _int("HPA2_SERVE_RESIDENT", 4096 if on_tpu else 8)
+    jobs_n = _int("HPA2_SERVE_JOBS", 4 * resident)
+    instrs = _int("HPA2_SERVE_INSTRS", 128 if on_tpu else 24)
+    window = _int("HPA2_SERVE_WINDOW", _tuned_shape()[1] if on_tpu else 8)
+    block = _int("HPA2_SERVE_BLOCK", _tuned_shape()[0] if on_tpu else 8)
+    policy = os.environ.get("HPA2_SERVE_POLICY", "fcfs")
+    backend = os.environ.get("HPA2_SERVE_BACKEND", "pallas")
+    return resident, jobs_n, instrs, window, block, policy, backend
+
+
+def serve_child_main(platform: str) -> int:
+    """The always-on serving benchmark (one JSON line):
+
+    1. capacity, pipelined: the whole feed released at once with
+       overlapped host-device staging -> sustained ops/sec + phase
+       split,
+    2. capacity, serial: same feed with ``overlap=False`` -> the
+       staging time the pipeline hides (``hidden_s``),
+    3. Poisson arrivals at ~60% of measured capacity -> p50/p99 job
+       latency under steady load,
+    4. heavy-tail zipf bursts at the same mean rate -> the tail under
+       overload bursts.
+    """
+    from hpa2_tpu.serving import (
+        ListJobSource, poisson_arrivals, serve, synthetic_jobs,
+        zipf_burst_arrivals)
+
+    config = _bench_config()
+    on_tpu = platform == "tpu"
+    (resident, jobs_n, instrs, window, block, policy,
+     backend) = _serve_knobs(on_tpu)
+    data_shards = _data_shards()
+    if backend == "pallas" and data_shards > 1:
+        backend = "pallas-sharded"
+
+    def _serve(jobs, *, overlap, timed=False):
+        return serve(
+            config, ListJobSource(jobs, timed=timed), backend=backend,
+            resident=resident, window=window, block=block,
+            policy=policy, data_shards=data_shards, overlap=overlap,
+            max_trace_len=instrs, decode_dumps=False,
+        )
+
+    jobs = synthetic_jobs(config, jobs_n, instrs, seed=0, dist="zipf",
+                          spread=4.0)
+    # warmup: populate the jit caches so the measured runs compare
+    # steady-state staging, not compile time
+    _serve(synthetic_jobs(config, min(jobs_n, 2 * resident), instrs,
+                          seed=99, dist="zipf", spread=4.0),
+           overlap=True)
+
+    _, pipelined = _serve(jobs, overlap=True)
+    _, serial = _serve(jobs, overlap=False)
+    hidden_s = max(0.0, serial.wall_s - pipelined.wall_s)
+    overlap_cmp = {
+        "pipelined_wall_s": round(pipelined.wall_s, 4),
+        "serial_wall_s": round(serial.wall_s, 4),
+        "hidden_s": round(hidden_s, 4),
+        # what fraction of the serial run's host staging the pipeline
+        # hid behind device execution
+        "staging_hidden_frac": round(
+            min(1.0, hidden_s / serial.host_staging_s), 3
+        ) if serial.host_staging_s > 0 else 0.0,
+    }
+
+    # arrival-process runs at ~60% of the measured capacity
+    capacity = max(pipelined.jobs_completed / pipelined.wall_s, 1e-9)
+    rate = 0.6 * capacity
+    arr_runs = {}
+    for name, arrivals in (
+        ("poisson", poisson_arrivals(jobs_n, rate, seed=1)),
+        ("zipf_burst", zipf_burst_arrivals(jobs_n, rate, seed=1)),
+    ):
+        feed = synthetic_jobs(config, jobs_n, instrs, seed=2,
+                              dist="zipf", spread=4.0,
+                              arrivals=arrivals)
+        _, st = _serve(feed, overlap=True, timed=True)
+        rec = st.as_dict()
+        rec["arrival_rate_jobs_per_s"] = round(rate, 2)
+        arr_runs[name] = rec
+
+    result = {
+        "metric": "serving_sustained_ops_per_sec",
+        "value": round(pipelined.ops_per_s, 1),
+        "unit": "RD/WR ops/sec",
+        "platform": platform,
+        # the CPU smoke shape measures nothing representative
+        "indicative": on_tpu,
+        "backend": backend,
+        "resident": resident,
+        "jobs": jobs_n,
+        "instrs_per_core": instrs,
+        "window": window,
+        "block": block,
+        "policy": policy,
+        "data_shards": data_shards,
+        "overlap": overlap_cmp,
+        "capacity_pipelined": pipelined.as_dict(),
+        "capacity_serial": serial.as_dict(),
+        "arrivals": arr_runs,
+    }
     print(json.dumps(result))
     return 0
 
@@ -565,25 +697,28 @@ def _filter_xla_spew(text: str) -> str:
     return "\n".join(kept) + ("\n" if kept else "")
 
 
+def _child_env(platform: str):
+    hostenv = _hostenv()
+    # the (data, node) mesh needs data_shards * node_shards devices
+    shards = _data_shards() * _node_shards()
+    return (
+        hostenv.cache_env(dict(os.environ))
+        if platform == "tpu"
+        # a sharded CPU smoke needs that many virtual devices
+        else hostenv.forced_cpu_env(
+            n_devices=shards if shards > 1 else None
+        )
+    )
+
+
 def _run_child(platform: str, timeout_s: int, pallas_ok: bool,
                pallas_error: str):
     """Run the measurement child; returns the parsed JSON dict or None."""
     try:
-        hostenv = _hostenv()
-        # the (data, node) mesh needs data_shards * node_shards devices
-        shards = _data_shards() * _node_shards()
-        env = (
-            hostenv.cache_env(dict(os.environ))
-            if platform == "tpu"
-            # a sharded CPU smoke needs that many virtual devices
-            else hostenv.forced_cpu_env(
-                n_devices=shards if shards > 1 else None
-            )
-        )
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", platform,
              "1" if pallas_ok else "0", pallas_error],
-            env=env,
+            env=_child_env(platform),
             cwd=_REPO_ROOT,
             timeout=timeout_s,
             capture_output=True,
@@ -605,6 +740,60 @@ def _run_child(platform: str, timeout_s: int, pallas_ok: bool,
     return None
 
 
+def _run_serve_child(platform: str, timeout_s: int):
+    """Run the serving-benchmark child; parsed JSON dict or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--child-serve", platform],
+            env=_child_env(platform),
+            cwd=_REPO_ROOT,
+            timeout=timeout_s,
+            capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"{platform} serve child: timeout ({timeout_s}s)",
+              file=sys.stderr)
+        return None
+    sys.stderr.write(_filter_xla_spew(proc.stderr.decode(errors="replace")))
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    print(f"{platform} serve child: rc={proc.returncode}, no JSON line",
+          file=sys.stderr)
+    return None
+
+
+def serve_main() -> int:
+    """``bench.py --serve``: the always-on serving benchmark, same
+    probe-in-subprocess discipline as the headline bench; always one
+    JSON line."""
+    tpu_ok = _probe_tpu()
+    result = None
+    if tpu_ok:
+        result = _run_serve_child("tpu", _TPU_CHILD_TIMEOUT_S)
+    if result is None:
+        result = _run_serve_child("cpu", _CPU_CHILD_TIMEOUT_S)
+        if result is not None and tpu_ok:
+            result["note"] = "tpu serve child failed; cpu smoke result"
+    if result is None:
+        result = {
+            "metric": "serving_sustained_ops_per_sec",
+            "value": 0.0,
+            "unit": "RD/WR ops/sec",
+            "platform": None,
+            "indicative": False,
+            "note": "all serve bench paths failed (tpu probe "
+                    f"{'ok' if tpu_ok else 'failed'}; see stderr)",
+        }
+    print(json.dumps(result))
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) >= 2 and sys.argv[1] == "--compile-gate":
         return compile_gate_main()
@@ -614,6 +803,8 @@ def main() -> int:
             len(sys.argv) < 4 or sys.argv[3] == "1",
             sys.argv[4] if len(sys.argv) > 4 else "",
         )
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child-serve":
+        return serve_child_main(sys.argv[2])
     if "--data-shards" in sys.argv:
         # split the ensemble over N local devices (DataShardedPallasEngine);
         # carried to the children via the environment
@@ -680,6 +871,11 @@ def main() -> int:
             return 2
     if "--host-barriers" in sys.argv:
         os.environ["HPA2_BENCH_HOST_BARRIERS"] = "1"
+    if "--serve" in sys.argv:
+        # always-on serving benchmark (ISSUE 10): sized via the
+        # HPA2_SERVE_* env knobs; --data-shards composes (dispatched
+        # after the argv->env parsing above so it takes effect)
+        return serve_main()
 
     tpu_ok = _probe_tpu()
     result = None

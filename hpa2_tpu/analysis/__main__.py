@@ -144,6 +144,7 @@ def cmd_occupancy(args: argparse.Namespace) -> int:
         groups=args.groups,
         seed=args.seed,
         fused=not args.host_barriers,
+        policies=[s.strip() for s in args.policy.split(",") if s.strip()],
     )
     print(table)
     if rc:
@@ -222,6 +223,10 @@ def main(argv=None) -> int:
     op.add_argument("--host-barriers", action="store_true",
                     help="model the PR-5 one-launch-per-interval host "
                          "loop instead of the fused single-program run")
+    op.add_argument("--policy", default="fcfs",
+                    help="comma-separated admission policies to "
+                         "compare (fcfs,longest-first) — one table "
+                         "row per policy")
     args = p.parse_args(argv)
     args.sem = [s.strip() for s in args.sem.split(",") if s.strip()]
     for s in args.sem:

@@ -39,6 +39,7 @@ def predicted_stats(
     groups: int = 1,
     threshold: float = 0.5,
     fused: bool = True,
+    policy: str = "fcfs",
 ) -> OccupancyStats:
     """Model a scheduled run over per-system trace lengths: convert
     lengths to segment counts and replay the barrier policy.  ``fused``
@@ -50,7 +51,7 @@ def predicted_stats(
     )
     return simulate(
         nseg, resident=resident, block=block, groups=groups,
-        threshold=threshold, fused=fused,
+        threshold=threshold, fused=fused, policy=policy,
     )
 
 
@@ -67,14 +68,17 @@ def occupancy_table(
     groups: int = 1,
     seed: int = 0,
     fused: bool = True,
+    policies: Sequence[str] = ("fcfs",),
 ) -> Tuple[str, int]:
     """The ``analysis occupancy`` report: scheduled vs lockstep
     block-segments per workload shape, plus the launch cost — host
     barriers and device programs per run (0 / 1 on the fused path,
-    n_intervals / n_intervals on the PR-5 host loop).  Returns
-    (table, rc) — rc is nonzero if the model ever predicts the
-    scheduler doing MORE work than lockstep (a policy bug, not a
-    modeling error)."""
+    n_intervals / n_intervals on the PR-5 host loop).  Passing more
+    than one admission policy renders one row per policy, turning the
+    table into a side-by-side policy comparison (the ``--policy``
+    flag).  Returns (table, rc) — rc is nonzero if the model ever
+    predicts the scheduler doing MORE work than lockstep (a policy
+    bug, not a modeling error)."""
     from hpa2_tpu.utils.trace import heterogeneous_lengths
 
     r = resident if resident else batch
@@ -82,9 +86,9 @@ def occupancy_table(
         f"Occupancy scheduler model  (batch={batch} resident={r} "
         f"block={block} window={window} max_instrs={max_instrs} "
         f"threshold={threshold} groups={groups} fused={fused})",
-        f"{'dist':>8} {'spread':>6} {'lockstep':>9} {'scheduled':>9} "
-        f"{'speedup':>8} {'live%':>6} {'compact':>7} {'admit':>6} "
-        f"{'barrier':>7} {'progrm':>6}",
+        f"{'dist':>8} {'spread':>6} {'policy':>13} {'lockstep':>9} "
+        f"{'scheduled':>9} {'speedup':>8} {'live%':>6} {'wait':>6} "
+        f"{'compact':>7} {'admit':>6} {'barrier':>7} {'progrm':>6}",
     ]
     rc = 0
     for dist in dists:
@@ -92,18 +96,21 @@ def occupancy_table(
             lens = heterogeneous_lengths(
                 batch, max_instrs, dist, spread, seed
             )
-            st = predicted_stats(
-                lens, window, block, resident=resident, groups=groups,
-                threshold=threshold, fused=fused,
-            )
-            if st.block_segments > st.lockstep_block_segments:
-                rc = 1
-            lines.append(
-                f"{dist:>8} {spread:>6.1f} "
-                f"{st.lockstep_block_segments:>9} "
-                f"{st.block_segments:>9} {st.speedup:>7.2f}x "
-                f"{100 * st.mean_live_fraction:>5.1f} "
-                f"{st.compactions:>7} {st.admissions:>6} "
-                f"{st.host_barriers:>7} {st.device_programs:>6}"
-            )
+            for policy in policies:
+                st = predicted_stats(
+                    lens, window, block, resident=resident,
+                    groups=groups, threshold=threshold, fused=fused,
+                    policy=policy,
+                )
+                if st.block_segments > st.lockstep_block_segments:
+                    rc = 1
+                lines.append(
+                    f"{dist:>8} {spread:>6.1f} {policy:>13} "
+                    f"{st.lockstep_block_segments:>9} "
+                    f"{st.block_segments:>9} {st.speedup:>7.2f}x "
+                    f"{100 * st.mean_live_fraction:>5.1f} "
+                    f"{st.wait_intervals_mean:>6.1f} "
+                    f"{st.compactions:>7} {st.admissions:>6} "
+                    f"{st.host_barriers:>7} {st.device_programs:>6}"
+                )
     return "\n".join(lines), rc

@@ -584,6 +584,81 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    config = _build_config(args)
+    if args.node_shards > 1:
+        raise SystemExit(
+            "serve shards the ensemble axis only (--data-shards); "
+            "node sharding is a run/bench feature"
+        )
+    backend = args.backend
+    if backend == "pallas" and args.data_shards > 1:
+        backend = "pallas-sharded"
+    if (args.jobs is None) == (args.listen is None):
+        raise SystemExit(
+            "serve needs exactly one job feed: a JOBS.jsonl path or "
+            "--listen HOST:PORT"
+        )
+    from hpa2_tpu.serving import FileJobSource, SocketJobSource, serve
+
+    if args.listen:
+        host, _, port = args.listen.rpartition(":")
+        try:
+            source = SocketJobSource(
+                config, host or "127.0.0.1", int(port)
+            )
+        except ValueError:
+            raise SystemExit("--listen takes HOST:PORT")
+        print(
+            f"[serve] listening on "
+            f"{source.address[0]}:{source.address[1]} "
+            "(JSONL job records; {\"eof\": true} ends the feed)",
+            file=sys.stderr,
+        )
+    else:
+        source = FileJobSource(
+            config, args.jobs, timed=not args.immediate
+        )
+
+    out = args.out
+    results_fh = (
+        open(args.results_jsonl, "w") if args.results_jsonl else None
+    )
+
+    def emit(res):
+        # stream each job's dumps/record the moment its lane retires
+        if out:
+            d = os.path.join(out, res.job_id)
+            os.makedirs(d, exist_ok=True)
+            _write_dumps(res.dumps, config, d)
+        if results_fh:
+            results_fh.write(json.dumps(res.to_record()) + "\n")
+            results_fh.flush()
+
+    try:
+        _, stats = serve(
+            config, source,
+            backend=backend,
+            resident=args.resident,
+            window=args.window,
+            block=args.block,
+            policy=args.policy,
+            data_shards=args.data_shards,
+            overlap=not args.no_overlap,
+            interval=args.interval,
+            max_trace_len=args.max_instr,
+            max_cycles=args.max_cycles,
+            decode_dumps=bool(out),
+            emit=emit,
+        )
+    finally:
+        source.close()
+        if results_fh:
+            results_fh.close()
+    print(json.dumps(stats.as_dict()))
+    return 0
+
+
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--node-shards", type=int, default=1,
@@ -753,6 +828,71 @@ def main(argv: Optional[List[str]] = None) -> int:
     bp.add_argument("--checkpoint-dir", default="hpa2_ckpt")
     _add_common(bp)
     bp.set_defaults(fn=cmd_bench)
+
+    sp = sub.add_parser(
+        "serve",
+        help="always-on serving: admit a continuous JSONL job feed "
+        "into resident lanes without recompiling",
+    )
+    sp.add_argument(
+        "jobs", nargs="?", default=None,
+        help="JSONL jobs file (one job per line; see README "
+        "'Always-on serving'); omit when using --listen",
+    )
+    sp.add_argument(
+        "--listen", metavar="HOST:PORT", default=None,
+        help="accept JSONL job records over TCP instead of a file; "
+        "a {\"eof\": true} record ends the feed",
+    )
+    sp.add_argument(
+        "--backend", choices=("pallas", "jax"), default="pallas",
+        help="pallas = resident-lane fast path (--data-shards > 1 "
+        "shards lanes over the device mesh); jax = XLA batch rows "
+        "(the backend with fault injection)",
+    )
+    sp.add_argument(
+        "--resident", type=int, default=16,
+        help="device-resident lanes/rows (the fixed serving shape)",
+    )
+    sp.add_argument(
+        "--window", type=int, default=16,
+        help="pallas backend: trace-window segment length",
+    )
+    sp.add_argument(
+        "--block", type=int, default=1024,
+        help="pallas backend: lane block width (clamped to resident)",
+    )
+    sp.add_argument(
+        "--interval", type=int, default=256,
+        help="jax backend: cycles per chunk between completion checks",
+    )
+    sp.add_argument(
+        "--policy", choices=("fcfs", "longest-first"), default="fcfs",
+        help="admission queue order at segment barriers",
+    )
+    sp.add_argument(
+        "--immediate", action="store_true",
+        help="ignore per-job arrival offsets; release the whole jobs "
+        "file at once (deterministic replay mode)",
+    )
+    sp.add_argument(
+        "--no-overlap", action="store_true",
+        help="sync the device after every dispatch instead of "
+        "pipelining host staging one interval ahead (the serial "
+        "baseline the benchmark compares against)",
+    )
+    sp.add_argument(
+        "--out", default=None,
+        help="write each job's dumps to OUT/<job-id>/"
+        "core_<n>_output.txt as its lane retires",
+    )
+    sp.add_argument(
+        "--results-jsonl", default=None, metavar="PATH",
+        help="stream one JSON result record (latency, counters) per "
+        "completed job",
+    )
+    _add_common(sp)
+    sp.set_defaults(fn=cmd_serve)
 
     args = ap.parse_args(argv)
     return args.fn(args)

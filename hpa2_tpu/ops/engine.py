@@ -552,17 +552,23 @@ class BatchJaxEngine:
         groups = self.data_shards
         gl, gs = r // groups, b // groups
         n_waves = -(-gs // gl)
-        # wave plan: group g's rows sweep its contiguous system slice
-        # gl at a time — exactly the admission order of the PR-5
-        # host-loop queues (row order within group, group-local)
+        # wave plan: group g's rows sweep its system slice gl at a time
+        # in admission-policy order — exactly the admission order of
+        # the PR-5 host-loop queues (row order within group, group-local)
+        from hpa2_tpu.ops.schedule import policy_order
+
+        tr_len = np.array([
+            max((len(t) for t in self._batch_traces[s]), default=0)
+            for s in range(b)
+        ], dtype=np.int64)
         wave_sys = np.full((n_waves, r), -1, dtype=np.int64)
         for g in range(groups):
+            order = g * gs + policy_order(
+                tr_len[g * gs:(g + 1) * gs], self.schedule.policy
+            )
             for k in range(n_waves):
-                base = g * gs + k * gl
-                cnt = max(0, min(gl, (g + 1) * gs - base))
-                wave_sys[k, g * gl:g * gl + cnt] = np.arange(
-                    base, base + cnt
-                )
+                chunk_s = order[k * gl:(k + 1) * gl]
+                wave_sys[k, g * gl:g * gl + len(chunk_s)] = chunk_s
 
         empty_traces = [[] for _ in range(cfg.num_procs)]
 
@@ -649,15 +655,22 @@ class BatchJaxEngine:
         # contiguous group partition, mirroring the Pallas scheduler:
         # each data shard owns a contiguous slice of rows and systems
         # and never exchanges work with its neighbors
+        from hpa2_tpu.ops.schedule import policy_order
+
+        tr_len = np.array([
+            max((len(t) for t in self._batch_traces[s]), default=0)
+            for s in range(self.b)
+        ], dtype=np.int64)
         groups = self.data_shards
         gl, gs = r // groups, self.b // groups
         row_sys = np.full(r, -1, dtype=np.int64)
         queues = []
         for g in range(groups):
-            row_sys[g * gl:(g + 1) * gl] = np.arange(
-                g * gs, g * gs + gl
+            order = g * gs + policy_order(
+                tr_len[g * gs:(g + 1) * gs], self.schedule.policy
             )
-            queues.append(deque(range(g * gs + gl, (g + 1) * gs)))
+            row_sys[g * gl:(g + 1) * gl] = order[:gl]
+            queues.append(deque(int(s) for s in order[gl:]))
         st = place(stack_states([fresh(s) for s in row_sys]))
         store: list = [None] * self.b
         stats = OccupancyStats()
@@ -761,3 +774,199 @@ class BatchJaxEngine:
     @property
     def instructions(self) -> int:
         return int(jnp.sum(self.state.n_instr))
+
+
+# ---------------------------------------------------------------------------
+# Resident-row serving session (hpa2_tpu/serving/): the always-on
+# analog of the scheduled chunk loop above.  Unlike the Pallas
+# session, row completion is NOT host-predictable — quiescence is a
+# device property — so the serving loop syncs once per chunk; ingest
+# staging (parsing jobs and building fresh row states) still overlaps
+# the in-flight chunk.
+
+
+def _session_donate() -> tuple:
+    """Donate the carried state through the jit boundary on device
+    backends; CPU has no donation (XLA would only warn and copy)."""
+    on_tpu = any("tpu" in str(d).lower() for d in jax.devices())
+    return (0,) if on_tpu else ()
+
+
+@functools.lru_cache(maxsize=16)
+def _build_session_chunk(config: SystemConfig, chunk: int):
+    """The bounded-advance chunk program of the scheduled path, jitted
+    with the carried rows donated (device backends), so a serving
+    session reuses its resident HBM planes across every chunk."""
+    step = build_step(config, replay=False)
+    vstep = jax.vmap(step)
+    vquiet = jax.vmap(quiescent)
+
+    def cond(c_st):
+        c, st = c_st
+        return (
+            (c < chunk)
+            & jnp.any(~vquiet(st))
+            & ~jnp.any(st.overflow)
+        )
+
+    def body(c_st):
+        c, st = c_st
+        return c + 1, vstep(st)
+
+    def run(st: SimState) -> SimState:
+        return jax.lax.while_loop(
+            cond, body, (jnp.zeros((), dtype=jnp.int32), st)
+        )[1]
+
+    return jax.jit(run, donate_argnums=_session_donate())
+
+
+class BatchLaneSession:
+    """Resident-row serving session for the XLA batch engine.
+
+    Holds ``resident`` rows of :class:`SimState` at fixed shapes; dead
+    rows carry an empty-trace state (quiescent from cycle 0, a fixed
+    point of the step), so they cost nothing but their lane.  The
+    serving loop drives chunks of ``interval`` cycles:
+
+    1. ``row = fresh_row(batch_traces)`` — stage an arriving job's
+       initial state (the ingest cost the loop hides behind the
+       in-flight chunk).
+    2. ``admit(idx, row)`` / ``retire(idx)`` — scatter a job into a
+       free row / reset a finished row to the empty state.
+    3. ``advance()`` — dispatch one chunk (async).
+    4. ``quiescent_rows()`` — sync; rows quiescent with a job resident
+       are finished (quiescence is a fixed point, so overshoot between
+       chunk boundaries never changes the dump).
+    5. ``take_row(idx)`` — gather one row's state for readback.
+
+    All programs are shape-stable: ``compile_counts()`` backs the
+    serving loop's zero-recompile guard, exactly as in
+    :class:`~hpa2_tpu.ops.pallas_engine.PallasLaneSession`.
+
+    This backend supports the fault-injection layer (the Pallas kernel
+    has no link-layer fault model), so it is the served analog of the
+    `--faults` CLI path.
+    """
+
+    def __init__(
+        self,
+        config: SystemConfig,
+        resident: int,
+        max_trace_len: int,
+        *,
+        interval: int = 256,
+        max_cycles: int = 1_000_000,
+        data_shards: int = 1,
+    ):
+        self.config = config
+        self.r = int(resident)
+        self._max_t = int(max_trace_len)
+        self.interval = max(1, int(interval))
+        self.max_cycles = max_cycles
+        self.mesh = None
+        if data_shards != 1:
+            from hpa2_tpu.parallel.sharding import (
+                _place, make_mesh, state_specs)
+
+            self.mesh = make_mesh(node_shards=1, data_shards=data_shards)
+            specs = state_specs(batched=True)
+            self._place = lambda st: _place(st, self.mesh, specs)
+        else:
+            self._place = lambda st: st
+        self._runner = _build_session_chunk(config, self.interval)
+        self._vq = jax.jit(jax.vmap(quiescent))
+        empty = [[] for _ in range(config.num_procs)]
+        self._empty_row = init_state(
+            config, empty, max_trace_len=self._max_t
+        )
+        self.state = self._place(
+            stack_states([self._empty_row] * self.r)
+        )
+
+        @jax.jit
+        def _admit(st, idx, row):
+            return jax.tree_util.tree_map(
+                lambda a, v: jax.lax.dynamic_update_index_in_dim(
+                    a, v, idx, 0
+                ),
+                st, row,
+            )
+
+        @jax.jit
+        def _take(st, idx):
+            return jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_index_in_dim(
+                    x, idx, 0, keepdims=False
+                ),
+                st,
+            )
+
+        self._admit_jit = _admit
+        self._take_jit = _take
+
+    def fresh_row(self, batch_traces) -> SimState:
+        """Build an arriving job's initial row state — the identical
+        ``init_state`` call (same rng seeding) the one-shot scheduled
+        engine uses, so served dumps match batch dumps byte-for-byte."""
+        return init_state(
+            self.config, batch_traces, max_trace_len=self._max_t
+        )
+
+    def admit(self, idx: int, row: SimState) -> None:
+        self.state = self._place(
+            self._admit_jit(self.state, jnp.int32(idx), row)
+        )
+
+    def retire(self, idx: int) -> None:
+        """Reset a harvested row to the empty-trace state so it stops
+        holding its chunk's while-loop open."""
+        self.admit(idx, self._empty_row)
+
+    def advance(self) -> None:
+        """Dispatch one chunk of up to ``interval`` cycles over every
+        row (async; all-quiescent chunks return immediately)."""
+        self.state = self._runner(self.state)
+
+    def quiescent_rows(self) -> np.ndarray:
+        """Sync: per-row quiescence after the in-flight chunk, plus the
+        overflow invariant check."""
+        st = self.state
+        if bool(jnp.any(st.overflow)):
+            raise StallError(
+                "internal invariant violated: mailbox overflow despite "
+                "backpressure"
+            )
+        return np.asarray(self._vq(st))
+
+    def take_row(self, idx: int) -> SimState:
+        """Async gather of one row's state (single-system leaves)."""
+        return self._take_jit(self.state, jnp.int32(idx))
+
+    def dumps_of(self, row: SimState) -> List[NodeDump]:
+        arrs = JaxEngine._live_arrays(row)
+        return [
+            _node_dump_from(arrs, i)
+            for i in range(self.config.num_procs)
+        ]
+
+    def counters_of(self, row: SimState) -> dict:
+        return {
+            "instructions": int(np.sum(np.asarray(row.n_instr))),
+            "cycles": int(np.asarray(row.cycle)),
+            "messages": int(np.sum(np.asarray(row.n_msgs))),
+        }
+
+    def stall_of(self, idx: int, reason: str) -> StallDiagnostic:
+        row = jax.tree_util.tree_map(
+            lambda x: np.asarray(x), self.take_row(idx)
+        )
+        return stall_diagnostic(self.config, row, reason)
+
+    def compile_counts(self) -> dict:
+        return {
+            "runner": int(self._runner._cache_size()),
+            "admit": int(self._admit_jit._cache_size()),
+            "take_row": int(self._take_jit._cache_size()),
+            "quiescent": int(self._vq._cache_size()),
+        }

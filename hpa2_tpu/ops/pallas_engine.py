@@ -1808,6 +1808,59 @@ def _join_word_planes_np(cvalw, cmetaw, dmemw, dmetaw):
     return cachew, dirw
 
 
+def decode_dumps(config: SystemConfig, cachew, dirw, sys_idx: int,
+                 dirs=None) -> List[NodeDump]:
+    """Decode one system's column of the packed word planes into the
+    reference per-node dump records (bit layout of assignment.c's
+    dumpProcessorState).  ``dirs`` supplies the split sharer-word
+    planes on geometries whose sharer mask outgrows the directory
+    word."""
+    n = config.num_procs
+    sh_mask = (1 << min(n, _SPLIT_BPW)) - 1
+    addr_mask = (1 << 21) - 1
+
+    def sharers_of(i):
+        if dirs is None:
+            return [
+                int(x)
+                for x in (dirw[i, :, sys_idx] >> _DW_SH_SHIFT)
+                & sh_mask
+            ]
+        return [
+            sum(
+                int(dirs[w][i, j, sys_idx]) << (w * _SPLIT_BPW)
+                for w in range(len(dirs))
+            )
+            for j in range(config.mem_size)
+        ]
+
+    return [
+        NodeDump(
+            proc_id=i,
+            memory=[int(x) for x in dirw[i, :, sys_idx] & 0xFF],
+            dir_state=[
+                int(x)
+                for x in (dirw[i, :, sys_idx] >> _DW_STATE_SHIFT) & 3
+            ],
+            dir_sharers=sharers_of(i),
+            cache_addr=[
+                int(x) - 1
+                for x in (cachew[i, :, sys_idx] >> _CW_ADDR_SHIFT)
+                & addr_mask
+            ],
+            cache_value=[
+                int(x)
+                for x in (cachew[i, :, sys_idx] >> _CW_VAL_SHIFT)
+                & 0xFF
+            ],
+            cache_state=[
+                int(x) for x in cachew[i, :, sys_idx] & 3
+            ],
+        )
+        for i in range(n)
+    ]
+
+
 def _init_state(config: SystemConfig, b: int, snapshots: bool = True,
                 packed: bool = False):
     """Initial packed state dict in transposed layout
@@ -2677,6 +2730,7 @@ class PallasEngine:
             self._nseg, resident=self._resident, block=self.block,
             groups=self._sched_groups,
             threshold=self.schedule.threshold,
+            policy=self.schedule.policy,
         )
         runner = self._fused_runner(max_cycles)
         state = {
@@ -2705,6 +2759,7 @@ class PallasEngine:
             self._nseg, resident=r, block=self.block,
             groups=self._sched_groups,
             threshold=self.schedule.threshold,
+            policy=self.schedule.policy,
         )
         runner = self._interval_runner(max_cycles)
         fields = list(self.state.keys())
@@ -2834,50 +2889,7 @@ class PallasEngine:
 
     def _dump(self, cachew, dirw, sys_idx: int,
               dirs=None) -> List[NodeDump]:
-        n = self.config.num_procs
-        sh_mask = (1 << min(n, _SPLIT_BPW)) - 1
-        addr_mask = (1 << 21) - 1
-
-        def sharers_of(i):
-            if dirs is None:
-                return [
-                    int(x)
-                    for x in (dirw[i, :, sys_idx] >> _DW_SH_SHIFT)
-                    & sh_mask
-                ]
-            return [
-                sum(
-                    int(dirs[w][i, j, sys_idx]) << (w * _SPLIT_BPW)
-                    for w in range(len(dirs))
-                )
-                for j in range(self.config.mem_size)
-            ]
-
-        return [
-            NodeDump(
-                proc_id=i,
-                memory=[int(x) for x in dirw[i, :, sys_idx] & 0xFF],
-                dir_state=[
-                    int(x)
-                    for x in (dirw[i, :, sys_idx] >> _DW_STATE_SHIFT) & 3
-                ],
-                dir_sharers=sharers_of(i),
-                cache_addr=[
-                    int(x) - 1
-                    for x in (cachew[i, :, sys_idx] >> _CW_ADDR_SHIFT)
-                    & addr_mask
-                ],
-                cache_value=[
-                    int(x)
-                    for x in (cachew[i, :, sys_idx] >> _CW_VAL_SHIFT)
-                    & 0xFF
-                ],
-                cache_state=[
-                    int(x) for x in cachew[i, :, sys_idx] & 3
-                ],
-            )
-            for i in range(n)
-        ]
+        return decode_dumps(self.config, cachew, dirw, sys_idx, dirs)
 
     def _split_planes(self, prefix: str):
         if not _split_mode(self.config):
@@ -2967,3 +2979,222 @@ class PallasEngine:
             },
             np.asarray(self.state["msg_counts"]).sum(axis=1),
         )
+
+
+# ---------------------------------------------------------------------------
+# Resident-lane serving session (hpa2_tpu/serving/): the always-on
+# analog of the scheduled run.  The engine classes above run ONE
+# ensemble to completion; a session keeps a fixed set of resident
+# lanes alive indefinitely and lets the serving loop drive intervals,
+# barriers, and per-lane harvests one step at a time, so ingest and
+# readback overlap device execution.
+
+
+@functools.lru_cache(maxsize=16)
+def _build_session_run(config: SystemConfig, r: int, bb: int, k: int,
+                       interpret: bool, window: int, max_calls: int,
+                       ablate: frozenset = frozenset(),
+                       gate: bool = True, stream: bool = True,
+                       packed: bool = False):
+    """The single-interval program of the scheduled path (``n_seg=1``),
+    jitted with the carried state donated (device backends only — the
+    interpreter has no donation), so the resident planes are reused
+    across every interval of an arbitrarily long serving session
+    instead of reallocated."""
+    raw = (_make_stream_run if stream else _make_run)(
+        config, r, bb, k, interpret, False, window, 1, max_calls,
+        ablate, gate, packed
+    )
+    return jax.jit(raw, donate_argnums=() if interpret else (0,))
+
+
+class PallasLaneSession:
+    """Resident-lane session for the Pallas fast path.
+
+    Holds ``resident`` lanes of carried state at fixed shapes forever;
+    the serving loop (:mod:`hpa2_tpu.serving.loop`) drives one
+    trace-window segment at a time:
+
+    1. ``tr, tl = stage(tr_np, tl_np)`` — ``device_put`` the next
+       interval's host-assembled trace windows (ahead of the barrier).
+    2. ``status = advance(tr, tl)`` — dispatch the interval program
+       (async; returns a device scalar, NOT synced).
+    3. ``cols = harvest(lane)`` — async gather of a retiring lane's
+       state column; must precede the barrier, whose donation retires
+       the planes the gather reads.
+    4. ``barrier(perm, reset)`` — the PR-5 compaction/admission
+       transform.
+    5. ``check(status)`` — sync and raise on stall/overflow, typically
+       one interval behind ``advance`` so the host stays ahead.
+
+    Every jitted program here is shape-stable, so after the first
+    interval the session never compiles again — ``compile_counts()``
+    exposes the jit cache sizes for the serving loop's zero-recompile
+    guard.
+    """
+
+    def __init__(
+        self,
+        config: SystemConfig,
+        resident: int,
+        window: int,
+        *,
+        block: int = 1024,
+        cycles_per_call: int = 128,
+        interpret: Optional[bool] = None,
+        gate: bool = True,
+        stream: bool = True,
+        packed: bool = False,
+        max_cycles: int = 1_000_000,
+    ):
+        if interpret is None:
+            interpret = not any(
+                "tpu" in str(d).lower() for d in jax.devices()
+            )
+        _check_geometry(config)
+        if packed:
+            packed_plane_dtypes(config)
+        self.config = config
+        self.r = int(resident)
+        self.window = int(window)
+        self.block = choose_block(self.r, block)
+        self.cycles_per_call = cycles_per_call
+        self.max_cycles = max_cycles
+        self._interpret = interpret
+        self._gate = gate
+        self._stream = stream
+        self._packed = packed
+        self._runner = self._build_runner()
+        init = _init_state(config, self.r, snapshots=False, packed=packed)
+        self._init = {f: jnp.asarray(v) for f, v in init.items()}
+        self.fields = list(init.keys())
+        self.state = {f: self._put(v) for f, v in self._init.items()}
+
+        init_ref = self._init
+        donate = () if interpret or not self._donate_barrier() else (0,)
+
+        @functools.partial(jax.jit, donate_argnums=donate)
+        def _barrier(state, perm, reset):
+            return {
+                f: jnp.where(
+                    reset, init_ref[f], jnp.take(v, perm, axis=-1)
+                )
+                for f, v in state.items()
+            }
+
+        @jax.jit
+        def _take_lane(state, lane):
+            return {
+                f: jax.lax.dynamic_index_in_dim(
+                    v, lane, axis=v.ndim - 1, keepdims=True
+                )
+                for f, v in state.items()
+            }
+
+        self._barrier_jit = _barrier
+        self._take_lane = _take_lane
+
+    # -- backend hooks (the sharded subclass overrides) ----------------
+
+    def _build_runner(self):
+        max_calls = max(1, -(-self.max_cycles // self.cycles_per_call))
+        return _build_session_run(
+            self.config, self.r, self.block, self.cycles_per_call,
+            self._interpret, self.window, max_calls, frozenset(),
+            self._gate, self._stream, self._packed,
+        )
+
+    def _put(self, x):
+        return jnp.asarray(x)
+
+    def _donate_barrier(self) -> bool:
+        return True
+
+    # -- serving protocol ----------------------------------------------
+
+    def stage(self, tr_int: np.ndarray, tl_int: np.ndarray):
+        """Ship the next interval's assembled ``[n, w, r]`` trace plane
+        and ``[n, r]`` window lengths to the device (async)."""
+        return (
+            self._put(jnp.asarray(tr_int)),
+            self._put(jnp.asarray(tl_int)),
+        )
+
+    def advance(self, tr, tl):
+        """Run every resident lane one trace-window segment (async
+        dispatch; the carried state is donated on device backends)."""
+        self.state, status = self._runner(self.state, tr, tl)
+        return status
+
+    def harvest(self, lane: int):
+        """Async gather of one lane's state columns (leaves ``[..., 1]``).
+        Call after :meth:`advance` and before :meth:`barrier`."""
+        return self._take_lane(self.state, jnp.int32(lane))
+
+    def barrier(self, perm: np.ndarray, reset: np.ndarray) -> None:
+        """Apply a :class:`~hpa2_tpu.ops.schedule.BarrierPlan`'s lane
+        permutation + admission resets to the carried state."""
+        st = self._barrier_jit(
+            self.state,
+            self._put(jnp.asarray(perm)),
+            self._put(jnp.asarray(reset)),
+        )
+        self.state = {f: self._put(v) for f, v in st.items()}
+
+    def check(self, status) -> None:
+        """Sync on an interval's status word; raises on stall/overflow
+        exactly like the batch engines."""
+        status = int(status)
+        if status & 2:
+            raise StallError(
+                "internal invariant violated: mailbox overflow despite "
+                "backpressure"
+            )
+        if status & 1:
+            raise StallError(
+                f"no quiescence within ~{self.max_cycles} cycles in one "
+                "serving interval (livelock? use Semantics.robust())"
+            )
+
+    def compile_counts(self) -> dict:
+        """Jit-cache sizes of every device program the session owns —
+        the serving loop's zero-recompile guard reads this after
+        warmup and again at shutdown."""
+        return {
+            "runner": int(self._runner._cache_size()),
+            "barrier": int(self._barrier_jit._cache_size()),
+            "take_lane": int(self._take_lane._cache_size()),
+        }
+
+    # -- readback ------------------------------------------------------
+
+    def _lane_word_planes(self, cols):
+        npc = {f: np.asarray(v) for f, v in cols.items()}
+        if self._packed:
+            cachew, dirw = _join_word_planes_np(
+                npc["cvalw"], npc["cmetaw"], npc["dmemw"], npc["dmetaw"]
+            )
+        else:
+            cachew, dirw = npc["cachew"], npc["dirw"]
+        dirs = None
+        if _split_mode(self.config):
+            dirs = [
+                npc[f"dirs{w}"]
+                for w in range(_sharer_words(self.config))
+            ]
+        return cachew, dirw, dirs
+
+    def dumps_of(self, cols) -> List[NodeDump]:
+        """Decode a harvested lane column into per-node dump records —
+        identical bytes to ``system_final_dumps`` of a one-shot run."""
+        cachew, dirw, dirs = self._lane_word_planes(cols)
+        return decode_dumps(self.config, cachew, dirw, 0, dirs)
+
+    def counters_of(self, cols) -> dict:
+        """The retiring job's scalar counters."""
+        sc = np.asarray(cols["scalars"])[:, 0]
+        return {
+            "instructions": int(sc[_SC_INSTR]),
+            "cycles": int(sc[_SC_CYCLE]),
+            "messages": int(sc[_SC_MSGS]),
+        }

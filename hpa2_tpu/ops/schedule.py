@@ -57,13 +57,39 @@ class Schedule:
     whole scheduled run as ONE device program from a precomputed
     :class:`SchedulePlan` (compaction/backfill applied on-device at
     the barriers); ``fused=False`` keeps the PR-5 host-barrier loop,
-    which relaunches one device program per interval.
+    which relaunches one device program per interval.  ``policy``:
+    admission order within each group's queue — ``"fcfs"`` admits in
+    ensemble order, ``"longest-first"`` admits systems with the most
+    remaining segments first, which packs stragglers early so the tail
+    of the run is short traces draining together.
     """
 
     resident: Optional[int] = None
     threshold: float = 0.5
     interval: int = 256
     fused: bool = True
+    policy: str = "fcfs"
+
+
+#: Admission-queue orderings understood by :class:`LaneScheduler`.
+POLICIES = ("fcfs", "longest-first")
+
+
+def policy_order(keys: np.ndarray, policy: str) -> np.ndarray:
+    """Indices of ``keys`` in the admission order ``policy`` dictates.
+
+    ``keys`` are per-system segment counts.  ``fcfs`` preserves the
+    given order; ``longest-first`` sorts by descending key, stably, so
+    equal-length systems keep their arrival order and the replay stays
+    deterministic.
+    """
+    keys = np.asarray(keys)
+    ids = np.arange(len(keys), dtype=np.int64)
+    if policy == "fcfs":
+        return ids
+    if policy == "longest-first":
+        return ids[np.argsort(-keys, kind="stable")]
+    raise ValueError(f"unknown policy {policy!r}; expected one of {POLICIES}")
 
 
 @dataclasses.dataclass
@@ -87,6 +113,15 @@ class OccupancyStats:
     #: separately launched device programs per run: ``intervals`` on
     #: the host-barrier path, exactly 1 when fused
     device_programs: int = 0
+    #: admission-queue depth sampled at every begin_interval (peak and
+    #: running sum for the mean) — for a batch run this is the not-yet-
+    #: resident backlog; for a served run it is the live job queue
+    queue_depth_peak: int = 0
+    queue_depth_sum: int = 0
+    #: lane-wait (admission latency) in intervals: how long admitted
+    #: systems sat queued between enqueue and their backfill barrier
+    wait_intervals_total: int = 0
+    wait_intervals_max: int = 0
 
     @property
     def mean_live_fraction(self) -> float:
@@ -101,6 +136,18 @@ class OccupancyStats:
             return 0.0
         return self.lockstep_block_segments / self.block_segments
 
+    @property
+    def queue_depth_mean(self) -> float:
+        if not self.intervals:
+            return 0.0
+        return self.queue_depth_sum / self.intervals
+
+    @property
+    def wait_intervals_mean(self) -> float:
+        if not self.admissions:
+            return 0.0
+        return self.wait_intervals_total / self.admissions
+
     def as_dict(self) -> dict:
         return {
             "intervals": self.intervals,
@@ -112,6 +159,10 @@ class OccupancyStats:
             "admissions": self.admissions,
             "host_barriers": self.host_barriers,
             "device_programs": self.device_programs,
+            "queue_depth_peak": self.queue_depth_peak,
+            "queue_depth_mean": round(self.queue_depth_mean, 3),
+            "wait_intervals_mean": round(self.wait_intervals_mean, 3),
+            "wait_intervals_max": self.wait_intervals_max,
         }
 
     def set_mode(self, fused: bool) -> "OccupancyStats":
@@ -165,6 +216,15 @@ class LaneScheduler:
     needs (>= 1).  ``resident`` lanes are split into ``groups`` equal
     contiguous lane ranges; systems are partitioned contiguously over
     groups and never migrate between them.
+
+    ``policy`` orders each group's admission queue (see
+    :data:`POLICIES`).  The default ``"fcfs"`` reproduces the PR-5/6
+    replay bit-for-bit.
+
+    A scheduler built with :meth:`serving` starts with *no* systems
+    and grows by :meth:`extend` as jobs arrive — the serving loop's
+    rolling extension of the batch replay.  Lanes, groups, and blocks
+    keep their fixed shapes; only the system table grows.
     """
 
     def __init__(
@@ -175,17 +235,23 @@ class LaneScheduler:
         block: int = 1,
         groups: int = 1,
         threshold: float = 0.5,
+        policy: str = "fcfs",
+        _serving: bool = False,
     ):
         nseg = np.asarray(nseg, dtype=np.int64)
-        if nseg.ndim != 1 or len(nseg) == 0:
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown policy {policy!r}; expected one of {POLICIES}"
+            )
+        if nseg.ndim != 1 or (len(nseg) == 0 and not _serving):
             raise ValueError("nseg must be a non-empty 1-D array")
         if (nseg < 1).any():
             raise ValueError("every system needs >= 1 segment")
         b = len(nseg)
         r = b if resident is None else int(resident)
-        if not (0 < r <= b):
+        if not _serving and not (0 < r <= b):
             raise ValueError(f"resident={r} outside 1..{b}")
-        if b % groups or r % groups:
+        if (b % groups and not _serving) or r % groups:
             raise ValueError(
                 f"batch {b} and resident {r} must divide into "
                 f"{groups} groups"
@@ -199,22 +265,92 @@ class LaneScheduler:
         self.b, self.r = b, r
         self.block, self.groups = block, groups
         self.threshold = float(threshold)
-        gl, gs = r // groups, b // groups  # lanes/systems per group
+        self.policy = policy
+        gl = r // groups
         self._gl = gl
+        self._serving = _serving
         self.lane_sys = np.full(r, -1, dtype=np.int64)
         self.lane_seg = np.zeros(r, dtype=np.int64)
-        self._queues: List[deque] = []
-        for g in range(groups):
-            sys0 = g * gs
-            fill = min(gl, gs)
-            self.lane_sys[g * gl:g * gl + fill] = np.arange(
-                sys0, sys0 + fill
-            )
-            self._queues.append(deque(range(sys0 + fill, sys0 + gs)))
+        self._queues: List[deque] = [deque() for _ in range(groups)]
+        #: stats.intervals at the moment each system was enqueued, for
+        #: the lane-wait (admission latency) counters
+        self._enq_at: List[int] = [0] * b
         self.stats = OccupancyStats(
             lockstep_block_segments=lockstep_block_segments(nseg, block)
         )
+        if not _serving:
+            gs = b // groups  # systems per group
+            for g in range(groups):
+                sys0 = g * gs
+                order = sys0 + policy_order(
+                    nseg[sys0:sys0 + gs], policy
+                )
+                fill = min(gl, gs)
+                self.lane_sys[g * gl:g * gl + fill] = order[:fill]
+                self._queues[g] = deque(int(s) for s in order[fill:])
         self._in_interval = False
+
+    @classmethod
+    def serving(
+        cls,
+        resident: int,
+        *,
+        block: int = 1,
+        groups: int = 1,
+        threshold: float = 0.5,
+        policy: str = "fcfs",
+    ) -> "LaneScheduler":
+        """An initially-empty scheduler for the always-on serving loop:
+        all admissions flow through :meth:`extend` + barrier plans."""
+        return cls(
+            np.zeros(0, dtype=np.int64), resident=resident, block=block,
+            groups=groups, threshold=threshold, policy=policy,
+            _serving=True,
+        )
+
+    def extend(self, nseg_new: np.ndarray) -> np.ndarray:
+        """Enqueue newly-arrived systems (serving mode): each joins the
+        group with the shortest queue (ties to the lowest group), and
+        each group's queue is re-ordered by ``policy``.  Returns the new
+        system ids, in arrival order."""
+        if not self._serving:
+            raise RuntimeError("extend() only valid on a serving scheduler")
+        nseg_new = np.asarray(nseg_new, dtype=np.int64)
+        if nseg_new.ndim != 1 or len(nseg_new) == 0:
+            raise ValueError("nseg_new must be a non-empty 1-D array")
+        if (nseg_new < 1).any():
+            raise ValueError("every system needs >= 1 segment")
+        sys0 = self.b
+        new_ids = sys0 + np.arange(len(nseg_new), dtype=np.int64)
+        self.nseg = np.concatenate([self.nseg, nseg_new])
+        self.b = len(self.nseg)
+        self._enq_at.extend([self.stats.intervals] * len(nseg_new))
+        self.stats.lockstep_block_segments += lockstep_block_segments(
+            nseg_new, self.block
+        )
+        touched = set()
+        for s in new_ids:
+            # live lanes count toward a group's load so arrivals spread
+            # across shards instead of piling onto the first queue
+            load = [
+                len(self._queues[g])
+                + int((self.lane_sys[g * self._gl:(g + 1) * self._gl]
+                       >= 0).sum())
+                for g in range(self.groups)
+            ]
+            g = int(np.argmin(load))
+            self._queues[g].append(int(s))
+            touched.add(g)
+        if self.policy != "fcfs":
+            for g in touched:
+                order = policy_order(
+                    np.asarray([self.nseg[s] for s in self._queues[g]]),
+                    self.policy,
+                )
+                self._queues[g] = deque(
+                    self._queues[g][int(i)] for i in order
+                )
+        return new_ids
 
     # -- interval protocol -------------------------------------------
 
@@ -239,6 +375,9 @@ class LaneScheduler:
         st.lane_intervals += self.r
         blk = live.reshape(-1, self.block)
         st.block_segments += int(blk.any(axis=1).sum())
+        depth = sum(len(q) for q in self._queues)
+        st.queue_depth_sum += depth
+        st.queue_depth_peak = max(st.queue_depth_peak, depth)
         return live
 
     def end_interval(self) -> BarrierPlan:
@@ -257,10 +396,23 @@ class LaneScheduler:
                 finished.append((int(lane), int(s)))
                 self.lane_sys[lane] = -1
                 self.lane_seg[lane] = 0
+        return self._plan_barrier(finished)
 
+    def flush_admissions(self) -> BarrierPlan:
+        """Backfill + compact *between* intervals — the serving loop's
+        way of admitting queued jobs when no lanes are live (nothing is
+        running, so there is no end-of-interval barrier to ride)."""
+        if self._in_interval:
+            raise RuntimeError("flush_admissions inside an interval")
+        return self._plan_barrier([])
+
+    def _plan_barrier(
+        self, finished: List[Tuple[int, int]]
+    ) -> BarrierPlan:
         admitted: List[Tuple[int, int]] = []
         perm = None
         gl = self._gl
+        st = self.stats
         for g in range(self.groups):
             lo, hi = g * gl, (g + 1) * gl
             q = self._queues[g]
@@ -272,6 +424,11 @@ class LaneScheduler:
                     self.lane_sys[lane] = s
                     self.lane_seg[lane] = 0
                     admitted.append((lane, s))
+                    wait = st.intervals - self._enq_at[s]
+                    st.wait_intervals_total += wait
+                    st.wait_intervals_max = max(
+                        st.wait_intervals_max, wait
+                    )
             if q:
                 continue  # group is full again; nothing to compact
             gperm = self._plan_compaction(lo, hi)
@@ -279,7 +436,7 @@ class LaneScheduler:
                 if perm is None:
                     perm = np.arange(self.r, dtype=np.int64)
                 perm[lo:hi] = gperm
-        self.stats.admissions += len(admitted)
+        st.admissions += len(admitted)
         return BarrierPlan(finished=finished, admitted=admitted, perm=perm)
 
     def _plan_compaction(self, lo: int, hi: int) -> Optional[np.ndarray]:
@@ -318,6 +475,7 @@ def simulate(
     groups: int = 1,
     threshold: float = 0.5,
     fused: bool = True,
+    policy: str = "fcfs",
 ) -> OccupancyStats:
     """The static occupancy model: replay the scheduling policy from a
     per-system segment-count vector alone.  Because the engines drive
@@ -327,7 +485,7 @@ def simulate(
     counters describe (the policy itself is mode-invariant)."""
     sched = LaneScheduler(
         nseg, resident=resident, block=block, groups=groups,
-        threshold=threshold,
+        threshold=threshold, policy=policy,
     )
     while not sched.done():
         sched.begin_interval()
@@ -375,12 +533,13 @@ def build_plan(
     block: int = 1,
     groups: int = 1,
     threshold: float = 0.5,
+    policy: str = "fcfs",
 ) -> SchedulePlan:
     """Replay the scheduling policy once, up-front, into the dense
     per-interval arrays the fused run program scans over."""
     sched = LaneScheduler(
         nseg, resident=resident, block=block, groups=groups,
-        threshold=threshold,
+        threshold=threshold, policy=policy,
     )
     r = sched.r
     ident = np.arange(r, dtype=np.int32)

@@ -41,7 +41,8 @@ from hpa2_tpu.models.protocol import Instr
 from hpa2_tpu.models.spec_engine import StallError
 from hpa2_tpu.ops.engine import (
     JaxEngine, _node_dump_from, engine_stats, stack_states)
-from hpa2_tpu.ops.pallas_engine import PallasEngine, choose_block
+from hpa2_tpu.ops.pallas_engine import (
+    PallasEngine, PallasLaneSession, choose_block)
 from hpa2_tpu.ops.state import SimState, init_state
 from hpa2_tpu.ops.step import build_step, quiescent
 from hpa2_tpu.utils.dump import NodeDump
@@ -621,6 +622,66 @@ class DataShardedPallasEngine(PallasEngine):
         return jax.device_put(
             x, NamedSharding(self.mesh, _lane_spec(x.ndim))
         )
+
+
+class DataShardedLaneSession(PallasLaneSession):
+    """The resident-lane serving session, data-parallel over the local
+    devices: each shard runs its own interval program over a contiguous
+    lane group (the serving scheduler is built with ``groups=shards``,
+    so barrier permutations stay block-diagonal and lanes never migrate
+    across devices).  Same serving protocol as the base session; only
+    operand placement and the runner differ, exactly mirroring
+    :class:`DataShardedPallasEngine` vs :class:`PallasEngine`."""
+
+    def __init__(
+        self,
+        config,
+        resident: int,
+        window: int,
+        *,
+        data_shards: Optional[int] = None,
+        mesh: Optional[Mesh] = None,
+        block: int = 1024,
+        **kwargs,
+    ):
+        if mesh is None:
+            mesh = make_data_mesh(data_shards)
+        if tuple(mesh.axis_names) != ("data",):
+            raise ValueError(
+                f"need a 1-D ('data',) mesh, got axes {mesh.axis_names}"
+            )
+        shards = mesh.shape["data"]
+        if resident % shards:
+            raise ValueError(
+                f"resident={resident} not divisible by "
+                f"data_shards={shards}"
+            )
+        self.mesh = mesh
+        self.data_shards = shards
+        block = choose_block(resident // shards, block)
+        super().__init__(
+            config, resident, window, block=block, **kwargs
+        )
+
+    def _build_runner(self):
+        max_calls = max(1, -(-self.max_cycles // self.cycles_per_call))
+        return build_data_sharded_pallas_run(
+            self.config, self.r // self.data_shards, self.block,
+            self.cycles_per_call, self._interpret, False, self.window,
+            1, max_calls, self.mesh, self._stream, frozenset(),
+            self._gate, self._packed,
+        )
+
+    def _put(self, x):
+        return jax.device_put(
+            x, NamedSharding(self.mesh, _lane_spec(x.ndim))
+        )
+
+    def _donate_barrier(self) -> bool:
+        # the barrier output is re-placed onto the mesh anyway; skip
+        # donation so XLA never has to reconcile donated layouts with
+        # the resharding device_put
+        return False
 
 
 # ---------------------------------------------------------------------------

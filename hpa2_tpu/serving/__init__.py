@@ -1,0 +1,34 @@
+"""Always-on serving: continuous-batching ingest over resident lanes.
+
+- :mod:`hpa2_tpu.serving.jobs` — the JSONL job format and generators.
+- :mod:`hpa2_tpu.serving.ingest` — file / socket / in-memory job
+  sources and seeded arrival processes.
+- :mod:`hpa2_tpu.serving.loop` — the serving loop itself: trace pool,
+  overlapped admission pipeline, phase timers, zero-recompile guard.
+
+Quick start::
+
+    from hpa2_tpu.serving import serve, ListJobSource, synthetic_jobs
+    jobs = synthetic_jobs(config, 64, 96, seed=7)
+    results, stats = serve(config, ListJobSource(jobs),
+                           backend="pallas", resident=16, window=16)
+"""
+
+from hpa2_tpu.serving.ingest import (
+    FileJobSource, JobSource, ListJobSource, SocketJobSource,
+    poisson_arrivals, zipf_burst_arrivals)
+from hpa2_tpu.serving.jobs import (
+    Job, JobResult, job_from_record, job_to_record, load_jobs_file,
+    parse_jobs_lines, synthetic_jobs)
+from hpa2_tpu.serving.loop import (
+    BatchServingSession, ServingSession, ServingStats, TracePool,
+    serve)
+
+__all__ = [
+    "BatchServingSession", "FileJobSource", "Job", "JobResult",
+    "JobSource", "ListJobSource", "ServingSession", "ServingStats",
+    "SocketJobSource", "TracePool", "job_from_record",
+    "job_to_record", "load_jobs_file", "parse_jobs_lines",
+    "poisson_arrivals", "serve", "synthetic_jobs",
+    "zipf_burst_arrivals",
+]

@@ -46,6 +46,12 @@ Steps, in value order:
                      (scripts/scale_runs.py nodeshard →
                      MULTICHIP_r07.json) and a sharded-only 4096-node
                      geometry no single chip fits
+ 15. serve512      — ISSUE-10 always-on serving at 32768 resident
+                     lanes (bench.py --serve with
+                     HPA2_SERVE_RESIDENT=32768): sustained ops/sec +
+                     p50/p99 job latency under Poisson and heavy-tail
+                     arrivals, with the pipelined-vs-serial staging
+                     overlap split
 
 All measure() steps run the HBM-streaming run program (PallasEngine
 default stream=True since the VMEM-wall PR).
@@ -576,6 +582,22 @@ def main() -> int:
             [os.path.abspath(__file__), "--measure-fused-occupancy",
              "32768", "128", "512", "128", "16", "32", "1", "8", "1"],
             timeout_s=2400, argv=True))
+
+    if "serve512" not in skip and gate("serve512"):
+        # ISSUE-10: the always-on serving loop at the shipped 32768
+        # resident shape — sustained ops/sec + p50/p99 job latency
+        # under Poisson and heavy-tail zipf-burst arrivals, plus the
+        # pipelined-vs-serial split showing how much host staging the
+        # overlap hides.  bench.py --serve runs its own TPU child
+        # under the cached-compile env and emits the one JSON line.
+        os.environ["HPA2_SERVE_RESIDENT"] = "32768"
+        try:
+            note(run_py(
+                "serve512",
+                [os.path.join(REPO, "bench.py"), "--serve"],
+                timeout_s=3600, argv=True))
+        finally:
+            os.environ.pop("HPA2_SERVE_RESIDENT", None)
 
     if "multichip" not in skip and gate("multichip"):
         # full data_shards ladder + bit-exactness gate; rewrites

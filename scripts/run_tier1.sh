@@ -78,6 +78,44 @@ then
   echo "TIER1: node-shard smoke failed" >&2
   exit 1
 fi
+# Serving smoke (~30s, CPU interpret): the ISSUE-10 always-on loop —
+# a short Poisson feed admitted into resident lanes must produce
+# byte-identical dumps to the one-shot scheduled batch run, with
+# every session program's jit cache at exactly one entry (the
+# zero-recompile pin).  Catches admission/barrier wiring breaks.
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python - > /dev/null <<'EOF'
+import numpy as np
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.ops.pallas_engine import PallasEngine
+from hpa2_tpu.ops.schedule import Schedule
+from hpa2_tpu.serving import (
+    ListJobSource, poisson_arrivals, serve, synthetic_jobs)
+
+cfg = SystemConfig(num_procs=4, semantics=Semantics().robust())
+jobs = synthetic_jobs(cfg, 8, 24, seed=7, spread=3.0,
+                      arrivals=poisson_arrivals(8, 200.0, seed=1))
+ref = PallasEngine(
+    cfg,
+    np.stack([j.tr_op for j in jobs]),
+    np.stack([j.tr_addr for j in jobs]),
+    np.stack([j.tr_val for j in jobs]),
+    np.stack([j.tr_len for j in jobs]),
+    block=4, trace_window=8, snapshots=False,
+    schedule=Schedule(resident=4, fused=False),
+).run()
+results, stats = serve(cfg, ListJobSource(jobs, timed=True),
+                       backend="pallas", resident=4, window=8, block=4)
+assert len(results) == 8
+for s, j in enumerate(jobs):
+    r = next(r for r in results if r.job_id == j.job_id)
+    assert r.dumps == ref.system_final_dumps(s), j.job_id
+assert all(c == 1 for c in stats.compile_counts.values()), \
+    stats.compile_counts
+EOF
+then
+  echo "TIER1: serving smoke failed" >&2
+  exit 1
+fi
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log

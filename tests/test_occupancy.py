@@ -472,6 +472,89 @@ def test_lane_permutation_invariance_both_engines(cfg, small_zipf):
         )
 
 
+# -- admission policy (ISSUE 10 satellite) ---------------------------------
+
+
+def test_policy_order_unit():
+    from hpa2_tpu.ops.schedule import POLICIES, policy_order
+
+    keys = np.array([3, 7, 7, 1])
+    assert policy_order(keys, "fcfs").tolist() == [0, 1, 2, 3]
+    # longest-first: descending remaining segments, stable among ties
+    assert policy_order(keys, "longest-first").tolist() == [1, 2, 0, 3]
+    with pytest.raises(ValueError, match="unknown policy"):
+        policy_order(keys, "shortest-first")
+    with pytest.raises(ValueError, match="unknown policy"):
+        LaneScheduler(np.ones(8, np.int64), resident=4, block=4,
+                      policy="bogus")
+    assert set(POLICIES) == {"fcfs", "longest-first"}
+
+
+def test_longest_first_bit_exact_and_model_pinned(cfg, small_zipf):
+    """``Schedule(policy="longest-first")`` reorders admission only —
+    dumps stay bit-exact vs the unscheduled run, the static model
+    replays the measured counters exactly, and on the skewed workload
+    the policy never does worse than fcfs (it packs stragglers
+    early)."""
+    arrays, ref = small_zipf
+    engs = {}
+    for policy in ("fcfs", "longest-first"):
+        eng = PallasEngine(
+            cfg, *arrays,
+            schedule=Schedule(resident=8, policy=policy), **_KW
+        ).run()
+        assert _dumps_match(eng, ref, 24)
+        model = simulate(
+            segments_needed(eng._tr_len_np, eng._window),
+            resident=8, block=_KW["block"], groups=1,
+            threshold=eng.schedule.threshold, policy=policy,
+        )
+        occ = eng.occupancy
+        assert model.block_segments == occ.block_segments
+        assert model.admissions == occ.admissions
+        assert model.wait_intervals_max == occ.wait_intervals_max
+        assert model.queue_depth_peak == occ.queue_depth_peak
+        engs[policy] = occ
+    assert (engs["longest-first"].block_segments
+            <= engs["fcfs"].block_segments)
+
+
+def test_queue_and_wait_counters(cfg, small_zipf):
+    """The queue-depth / lane-wait serving counters: present in
+    as_dict, zero when the whole ensemble is resident, active when the
+    ensemble streams through a smaller residency."""
+    arrays, ref = small_zipf
+    full = PallasEngine(
+        cfg, *arrays, schedule=Schedule(), **_KW
+    ).run().occupancy.as_dict()
+    assert full["queue_depth_peak"] == 0
+    assert full["wait_intervals_mean"] == 0.0
+
+    eng = PallasEngine(
+        cfg, *arrays, schedule=Schedule(resident=8), **_KW
+    ).run()
+    d = eng.occupancy.as_dict()
+    # 24 systems into 8 lanes: 16 queued at interval 0
+    assert d["queue_depth_peak"] == 16
+    assert 0 < d["queue_depth_mean"] <= 16
+    assert d["wait_intervals_max"] >= d["wait_intervals_mean"] > 0
+    st = eng.occupancy
+    assert st.wait_intervals_total <= (
+        st.wait_intervals_max * st.admissions
+    )
+
+
+def test_occupancy_cli_policy_column():
+    from hpa2_tpu.analysis.occupancy import occupancy_table
+
+    table, rc = occupancy_table(
+        32, 48, 8, 8, spreads=(4.0,), policies=("fcfs", "longest-first")
+    )
+    assert rc == 0
+    assert "longest-first" in table and "fcfs" in table
+    assert "wait" in table
+
+
 # -- heterogeneous workload generator --------------------------------------
 
 
