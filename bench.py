@@ -452,6 +452,34 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
         )
     except Exception as e:  # optional context only — never fatal
         result["native_lockstep_note"] = f"lockstep context failed: {e}"
+    try:
+        # link-layer observability (ISSUE 11 satellite): a small faulty
+        # ensemble surfaces the retransmission/delay counters in the
+        # artifact, so fault-injection coverage is visible per round
+        # (deterministic: seeded fault stream, fixed traces)
+        import dataclasses as _dc
+
+        from hpa2_tpu.config import FaultModel
+        from hpa2_tpu.ops.engine import BatchJaxEngine
+        from hpa2_tpu.utils.trace import gen_uniform_random
+
+        fcfg = _dc.replace(
+            config,
+            interconnect=_dc.replace(
+                config.interconnect,
+                fault=FaultModel(drop=0.2, duplicate=0.05,
+                                 reorder=0.05, delay=0.1, seed=7),
+            ),
+        )
+        fbe = BatchJaxEngine(
+            fcfg, [gen_uniform_random(fcfg, 16, seed=s) for s in range(4)]
+        ).run()
+        result["fault_counters"] = {
+            k: v for k, v in fbe.stats().items()
+            if k.startswith("fault_")
+        }
+    except Exception as e:  # optional context only — never fatal
+        result["fault_counters_note"] = f"faulty context failed: {e}"
     print(json.dumps(result))
     return 0
 
@@ -768,6 +796,117 @@ def _run_serve_child(platform: str, timeout_s: int):
     return None
 
 
+def topo_main() -> int:
+    """``bench.py --topology``: the ISSUE-11 interconnect study.
+
+    Reports the invalidation-storm cost (TOPO_r11.json) of every
+    non-ideal topology under the unicast / multicast / combining
+    delivery variants: run cycles, slowdown over ideal, the topo
+    counters, and the per-link stats.  The numbers are *model* output
+    — deterministic cycle counts from the spec engine, a pure function
+    of config + trace (no wall clock anywhere) — and every topology is
+    cross-checked against the XLA engine (dumps + cycles + counters
+    must agree exactly) before it is reported.  Spec-engine timing on
+    CPU measures nothing representative, so CPU runs are tagged
+    ``indicative: false``.
+    """
+    import dataclasses
+
+    from hpa2_tpu.analysis.topology import (
+        VARIANTS, storm_run, storm_traces)
+    from hpa2_tpu.config import InterconnectConfig, SystemConfig
+
+    def _int(name, default):
+        try:
+            return int(os.environ.get(name, str(default)))
+        except ValueError:
+            return default
+
+    nodes = _int("HPA2_TOPO_NODES", 8)
+    rounds = _int("HPA2_TOPO_ROUNDS", 6)
+    bandwidth = _int("HPA2_TOPO_BANDWIDTH", 1)
+    base_cfg = SystemConfig(num_procs=nodes, max_instr_num=0)
+    traces = storm_traces(base_cfg, rounds)
+    ideal_cycles, _, _ = storm_run(base_cfg, traces)
+
+    try:
+        import jax
+
+        on_tpu = any("tpu" in str(d).lower() for d in jax.devices())
+    except Exception:
+        on_tpu = False
+
+    def _cross_check(cfg) -> bool:
+        """XLA engine agrees with the spec engine byte-for-byte."""
+        from hpa2_tpu.ops.engine import JaxEngine
+
+        sp = __import__(
+            "hpa2_tpu.models.spec_engine", fromlist=["SpecEngine"]
+        ).SpecEngine(cfg, [list(t) for t in traces])
+        sp.run()
+        jx = JaxEngine(cfg, [list(t) for t in traces]).run()
+        return (
+            [dataclasses.asdict(d) for d in sp.final_dumps()]
+            == [dataclasses.asdict(d) for d in jx.final_dumps()]
+            and sp.cycle == jx.cycle
+            and sp.link_stats()["traversals"]
+            == jx.link_stats()["traversals"]
+        )
+
+    topos = {}
+    agree = True
+    for topo in ("mesh2d", "torus2d", "hierarchical"):
+        rows = {}
+        for vname, kw in VARIANTS:
+            cfg = dataclasses.replace(
+                base_cfg,
+                interconnect=InterconnectConfig(
+                    topology=topo, link_bandwidth=bandwidth, **kw
+                ),
+            )
+            cycles, stats, link = storm_run(cfg, traces)
+            rows[vname] = {
+                "cycles": cycles,
+                "slowdown_over_ideal": round(cycles / ideal_cycles, 3),
+                "topo_delay_cycles": stats.get("topo_delay_cycles", 0),
+                "topo_multicast_saved": stats.get(
+                    "topo_multicast_saved", 0
+                ),
+                "topo_combined": stats.get("topo_combined", 0),
+                "links": link,
+            }
+        try:
+            ok = _cross_check(dataclasses.replace(
+                base_cfg,
+                interconnect=InterconnectConfig(
+                    topology=topo, link_bandwidth=bandwidth
+                ),
+            ))
+        except Exception as e:  # cross-check must never hide the data
+            ok = False
+            rows["cross_check_error"] = str(e)
+        agree = agree and ok
+        rows["spec_jax_agree"] = ok
+        topos[topo] = rows
+
+    mc = topos["mesh2d"]
+    result = {
+        "metric": "invalidation_storm_slowdown_mesh2d_unicast",
+        "value": mc["unicast"]["slowdown_over_ideal"],
+        "unit": "x ideal cycles",
+        "platform": "tpu" if on_tpu else "cpu",
+        "indicative": on_tpu,
+        "nodes": nodes,
+        "storm_rounds": rounds,
+        "link_bandwidth": bandwidth,
+        "ideal_cycles": ideal_cycles,
+        "spec_jax_agree_all": agree,
+        "topologies": topos,
+    }
+    print(json.dumps(result))
+    return 0
+
+
 def serve_main() -> int:
     """``bench.py --serve``: the always-on serving benchmark, same
     probe-in-subprocess discipline as the headline bench; always one
@@ -876,6 +1015,10 @@ def main() -> int:
         # HPA2_SERVE_* env knobs; --data-shards composes (dispatched
         # after the argv->env parsing above so it takes effect)
         return serve_main()
+    if "--topology" in sys.argv:
+        # interconnect sensitivity study (ISSUE 11): sized via the
+        # HPA2_TOPO_* env knobs; model output, spec/XLA cross-checked
+        return topo_main()
 
     tpu_ok = _probe_tpu()
     result = None

@@ -8,6 +8,7 @@ Subcommands::
     python -m hpa2_tpu.analysis mutation-test  # analyzer self-test
     python -m hpa2_tpu.analysis vmem           # static VMEM budget model
     python -m hpa2_tpu.analysis occupancy      # occupancy scheduler model
+    python -m hpa2_tpu.analysis topology       # interconnect sensitivity
 
 ``check`` is the cheap gate (pure Python, no JAX import): whole-table
 static checks plus the spec-engine equivalence diff, on both the
@@ -153,6 +154,18 @@ def cmd_occupancy(args: argparse.Namespace) -> int:
     return rc
 
 
+def cmd_topology(args: argparse.Namespace) -> int:
+    from hpa2_tpu.analysis.topology import topology_table
+
+    topos = [t.strip() for t in args.topologies.split(",") if t.strip()]
+    print(topology_table(
+        nodes=args.nodes, rounds=args.rounds,
+        hop_latency=args.hop_latency, bandwidth=args.bandwidth,
+        topologies=topos,
+    ))
+    return 0
+
+
 def cmd_mutation_test(args: argparse.Namespace) -> int:
     from hpa2_tpu.analysis.mutate import run_all_mutations
 
@@ -227,6 +240,15 @@ def main(argv=None) -> int:
                     help="comma-separated admission policies to "
                          "compare (fcfs,longest-first) — one table "
                          "row per policy")
+    tp = sub.add_parser("topology", help="interconnect sensitivity "
+                        "(invalidation-storm cost per topology)")
+    tp.add_argument("--nodes", type=int, default=8)
+    tp.add_argument("--rounds", type=int, default=6,
+                    help="storm rounds (each: all-read then one write)")
+    tp.add_argument("--hop-latency", type=int, default=1)
+    tp.add_argument("--bandwidth", type=int, default=1,
+                    help="messages per link per cycle")
+    tp.add_argument("--topologies", default="mesh2d,torus2d,hierarchical")
     args = p.parse_args(argv)
     args.sem = [s.strip() for s in args.sem.split(",") if s.strip()]
     for s in args.sem:
@@ -244,6 +266,7 @@ def main(argv=None) -> int:
         "mutation-test": cmd_mutation_test,
         "vmem": cmd_vmem,
         "occupancy": cmd_occupancy,
+        "topology": cmd_topology,
     }[args.cmd](args)
 
 

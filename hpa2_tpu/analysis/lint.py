@@ -34,6 +34,13 @@ Five rules, all tuned to be zero-finding on clean engine code:
   to a real method, and every ``MsgType`` must be dispatched.  An
   unregistered handler is dead code that *looks* like protocol
   coverage.
+* **interconnect-purity** — ``hpa2_tpu/interconnect/`` may not even
+  *import* ``random``/``time``/``datetime``/``uuid``/``secrets``.  The
+  interconnect's contract is stronger than the engines': delivery
+  cycles are a pure function of config + trace — the fault layer keeps
+  a *seeded* RNG, the topology model keeps **none** (its spec/JAX
+  agreement proof depends on it), so in this package a seeded
+  ``random.Random`` is banned too.
 
 CLI: ``python -m hpa2_tpu.analysis lint`` (a tier-1 test runs it).
 """
@@ -46,9 +53,15 @@ import os
 from typing import Iterable, List, Optional, Set
 
 #: directories (repo-relative) whose files are engine paths
-ENGINE_DIRS = (os.path.join("hpa2_tpu", "models"), os.path.join("hpa2_tpu", "ops"))
+ENGINE_DIRS = (
+    os.path.join("hpa2_tpu", "models"),
+    os.path.join("hpa2_tpu", "ops"),
+    os.path.join("hpa2_tpu", "interconnect"),
+)
 #: op modules additionally subject to traced-branch and dtype-drift
 OPS_DIR = os.path.join("hpa2_tpu", "ops")
+#: the interconnect package: subject to the strict purity rule
+INTERCONNECT_DIR = os.path.join("hpa2_tpu", "interconnect")
 
 #: parameter names / annotations treated as traced state roots
 STATE_PARAM_NAMES = {"st", "state", "sim_state", "nxt", "prev_state"}
@@ -226,6 +239,51 @@ class _NondeterminismVisitor(ast.NodeVisitor):
                         "nondeterminism", self.path, node.lineno,
                         f"datetime.{parent.attr}.{f.attr}() in an "
                         f"engine path"))
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# interconnect-purity
+# ---------------------------------------------------------------------------
+
+#: modules whose mere import is a determinism hazard in the
+#: interconnect package (delivery cycles must be a pure function of
+#: config + trace — even a seeded PRNG is banned here)
+_PURITY_BANNED_MODULES = {"random", "time", "datetime", "uuid", "secrets"}
+
+
+class _InterconnectPurityVisitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[LintFinding] = []
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(LintFinding(
+            "interconnect-purity", self.path, node.lineno,
+            f"{what} in hpa2_tpu/interconnect/ — delivery delays must "
+            f"be a pure function of config + trace (no clocks, no RNG; "
+            f"even a seeded random.Random is banned here)"))
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            top = alias.name.split(".")[0]
+            if top in _PURITY_BANNED_MODULES:
+                self._flag(node, f"import {alias.name}")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        top = (node.module or "").split(".")[0]
+        if top in _PURITY_BANNED_MODULES:
+            self._flag(node, f"from {node.module} import ...")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # catches uses that dodge the import scan (e.g. np.random.*)
+        if isinstance(node.value, ast.Name) and (
+            node.value.id in _PURITY_BANNED_MODULES
+            or (node.value.id in ("np", "numpy") and node.attr == "random")
+        ):
+            self._flag(node, f"{node.value.id}.{node.attr}")
         self.generic_visit(node)
 
 
@@ -459,6 +517,10 @@ def lint_file(repo_root: str, rel: str) -> List[LintFinding]:
         v = _NondeterminismVisitor(rel)
         v.visit(tree)
         findings.extend(v.findings)
+    if rel.startswith(INTERCONNECT_DIR + os.sep):
+        ip = _InterconnectPurityVisitor(rel)
+        ip.visit(tree)
+        findings.extend(ip.findings)
     if _is_ops_path(rel):
         tb = _TracedBranchVisitor(rel)
         tb.visit(tree)
@@ -478,6 +540,9 @@ def default_targets(repo_root: str) -> List[str]:
     out: List[str] = []
     for d in ENGINE_DIRS:
         full = os.path.join(repo_root, d)
+        if not os.path.isdir(full):
+            # synthetic lint-test roots carry only the dirs they probe
+            continue
         for name in sorted(os.listdir(full)):
             if name.endswith(".py"):
                 out.append(os.path.join(d, name))

@@ -96,6 +96,29 @@ def _build_config(args) -> SystemConfig:
             "backends (the pallas kernel and the native engine have "
             "no link-layer fault model)"
         )
+    from hpa2_tpu.config import InterconnectConfig
+
+    topology = getattr(args, "topology", "ideal")
+    interconnect = InterconnectConfig(
+        topology=topology,
+        hop_latency=getattr(args, "hop_latency", 1),
+        link_bandwidth=getattr(args, "link_bandwidth", 1),
+        multicast=getattr(args, "multicast", False),
+        combining=getattr(args, "combining", False),
+        fault=fault,
+    )
+    if interconnect.enabled:
+        if backend not in ("spec", "jax"):
+            raise SystemExit(
+                "non-ideal topologies are implemented by the spec and "
+                "jax backends (the pallas kernel and the native engine "
+                "deliver every message next cycle)"
+            )
+        if getattr(args, "node_shards", 1) != 1:
+            raise SystemExit(
+                "non-ideal topologies run single-shard only; "
+                "--node-shards composes with --topology ideal"
+            )
     return SystemConfig(
         num_procs=args.nodes,
         cache_size=args.cache_size,
@@ -104,7 +127,7 @@ def _build_config(args) -> SystemConfig:
         max_instr_num=args.max_instr,
         messages_per_cycle=k,
         semantics=sem,
-        fault=fault,
+        interconnect=interconnect,
     )
 
 
@@ -745,6 +768,37 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="restrict faults to the directed link S->R (-1 = any); "
         "e.g. --fault-drop 1.0 --fault-edge 1:0 severs one link to "
         "exercise the watchdog",
+    )
+    tg = p.add_argument_group(
+        "interconnect topology (spec/jax backends; the default "
+        "'ideal' delivers every message next cycle — byte-identical "
+        "to the pre-topology engines)"
+    )
+    tg.add_argument(
+        "--topology", default="ideal",
+        choices=("ideal", "mesh2d", "torus2d", "hierarchical"),
+        help="per-message delivery delay model: base hop latency "
+        "along the routed path plus deterministic per-link queueing "
+        "under finite bandwidth (hpa2_tpu/interconnect/)",
+    )
+    tg.add_argument(
+        "--hop-latency", type=int, default=1, metavar="CYC",
+        help="cycles per hop (DCN tier of 'hierarchical' costs 4x)",
+    )
+    tg.add_argument(
+        "--link-bandwidth", type=int, default=1, metavar="MSGS",
+        help="messages per link per cycle before queueing delay "
+        "accrues (deterministic FIFO, tie-break by walk order)",
+    )
+    tg.add_argument(
+        "--multicast", action="store_true",
+        help="invalidation fan-outs traverse each shared path link "
+        "once instead of once per destination",
+    )
+    tg.add_argument(
+        "--combining", action="store_true",
+        help="same-address read requests merge in-network (only the "
+        "first occupies the links)",
     )
     p.add_argument(
         "--watchdog-cycles", type=int, default=10_000, metavar="K",

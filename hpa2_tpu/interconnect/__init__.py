@@ -1,0 +1,18 @@
+"""Topology-aware interconnect model (deterministic, jitter-free).
+
+Static topologies (hop paths + base latencies) live in
+:mod:`hpa2_tpu.interconnect.topology`; the per-cycle link-occupancy
+reference walk lives in :mod:`hpa2_tpu.interconnect.delay`.  Both
+engines consume them: the spec engine scalar-by-scalar, the JAX step
+as baked constants.  Everything here must stay a pure function of
+config + trace — no ``random``, no ``time`` (lint-enforced).
+"""
+
+from hpa2_tpu.interconnect.delay import LinkTracker
+from hpa2_tpu.interconnect.topology import (
+    TOPOLOGIES,
+    Topology,
+    build_topology,
+)
+
+__all__ = ["TOPOLOGIES", "Topology", "build_topology", "LinkTracker"]

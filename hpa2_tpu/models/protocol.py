@@ -94,6 +94,11 @@ class Message:
     value: int = 0
     sharers: int = 0
     second_receiver: int = NO_PROC
+    # Delivery cycle assigned by the interconnect model at acceptance
+    # (hpa2_tpu/interconnect/): the receiver handles this message only
+    # once ``cycle >= deliver_at``.  0 (the ideal topology) means
+    # "next cycle", today's behavior.
+    deliver_at: int = 0
 
     def copy(self) -> "Message":
         return dataclasses.replace(self)

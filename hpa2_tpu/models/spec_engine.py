@@ -234,6 +234,22 @@ class SpecEngine:
         self._fault_rng: Optional[random.Random] = (
             random.Random(config.fault.seed) if config.fault.enabled else None
         )
+        # topology-aware interconnect (None for the ideal topology:
+        # delivery stays next-cycle and zero-cost, byte-identical to
+        # the pre-topology engine)
+        ic = config.interconnect
+        self.link_tracker = None
+        if ic.enabled:
+            from hpa2_tpu.interconnect.delay import LinkTracker
+            from hpa2_tpu.interconnect.topology import build_topology
+
+            self.link_tracker = LinkTracker(
+                build_topology(ic.topology, config.num_procs,
+                               ic.hop_latency),
+                bandwidth=ic.link_bandwidth,
+                multicast=ic.multicast,
+                combining=ic.combining,
+            )
         # watchdog bookkeeping: last cycle that retired an instruction
         # or drained a mailbox, plus the delivery flight recorder
         self.last_activity_cycle = 0
@@ -319,6 +335,9 @@ class SpecEngine:
         merged.sort(key=lambda t: (t[0], t[1]))  # stable
         delivered_any = False
         fault_on = self._fault_rng is not None
+        tracker = self.link_tracker
+        if tracker is not None:
+            tracker.begin_cycle()
         stalled_edges = set()
         for ph, sender, receiver, msg in merged:
             box = self.nodes[receiver].mailbox
@@ -331,6 +350,13 @@ class SpecEngine:
                     stalled_edges.add(edge)
                     ok = False
             if ok:
+                if tracker is not None:
+                    msg.deliver_at = tracker.on_accept(
+                        self.cycle, sender, receiver, int(msg.type),
+                        msg.address,
+                        is_inv=msg.type == MsgType.INV,
+                        is_read_request=msg.type == MsgType.READ_REQUEST,
+                    )
                 box.append(msg)
                 delivered_any = True
                 self.recent_msgs.record(
@@ -347,6 +373,8 @@ class SpecEngine:
                     self.max_mailbox_depth = len(box)
             else:
                 self.nodes[sender].pending_sends.append((ph, receiver, msg))
+        if tracker is not None:
+            tracker.end_cycle()
         return delivered_any
 
     # -- cache replacement (assignment.c:742-773) ---------------------
@@ -820,6 +848,11 @@ class SpecEngine:
             for _ in range(self.config.messages_per_cycle):
                 if not node.mailbox:
                     break
+                # interconnect gating: the mailbox is an ordered virtual
+                # channel — the head blocks until its delivery cycle
+                # (later entries wait behind it, preserving FIFO)
+                if node.mailbox[0].deliver_at > self.cycle:
+                    break
                 msg = node.mailbox.popleft()
                 if self.trace_msgs:
                     self.msg_log.append(
@@ -946,8 +979,24 @@ class SpecEngine:
             invariant_violations=check_invariants(
                 self.final_dumps(), self.config, mid_flight=True
             ),
-            counters=dict(self.counters),
+            counters=self.stats(),
         )
+
+    def stats(self) -> Dict[str, int]:
+        """Counter dict in the shared one-stats-schema shape: engine
+        counters plus the interconnect aggregates (only-when-nonzero,
+        so ideal/fault-free parity with the JAX engines stays
+        key-for-key exact)."""
+        out = dict(self.counters)
+        if self.link_tracker is not None:
+            out.update(self.link_tracker.counters())
+        return out
+
+    def link_stats(self) -> Dict[str, dict]:
+        """Per-link interconnect observability (empty for ideal)."""
+        if self.link_tracker is None:
+            return {}
+        return self.link_tracker.link_stats()
 
     def run(
         self,
@@ -981,7 +1030,14 @@ class SpecEngine:
                     f"drained for {watchdog_cycles} cycles"
                 )
             if not progress:
-                stall += 1
+                # a cycle that only waited on in-flight interconnect
+                # delays is not a livelock: gated heads become
+                # handleable once their delivery cycle arrives
+                gated = any(
+                    n.mailbox and n.mailbox[0].deliver_at > self.cycle
+                    for n in self.nodes
+                )
+                stall = 0 if gated else stall + 1
                 if stall > 2 and not fault_on:
                     raise self.stall_diagnostic(
                         f"livelock at cycle {self.cycle}: stale "

@@ -213,6 +213,11 @@ class JaxEngine:
         reference has no observability at all — SURVEY.md §5)."""
         return engine_stats(self.state)
 
+    def link_stats(self) -> dict:
+        """Per-link interconnect counters ({} under the ideal
+        topology)."""
+        return link_stats(self.state, self.config)
+
 
 def stall_diagnostic(
     config: SystemConfig, st: SimState, reason: str
@@ -309,11 +314,49 @@ def engine_stats(st: SimState) -> dict:
         ("fault_reorders_fixed", st.n_reorder_fixed),
         ("fault_delays", st.n_delays),
         ("fault_link_stalls", st.n_wire_stalls),
+        # interconnect counters: same only-when-nonzero convention,
+        # so topology="ideal" keeps the schema byte-for-byte
+        ("topo_delay_cycles", st.n_topo_delay),
+        ("topo_multicast_saved", st.n_multicast_saved),
+        ("topo_combined", st.n_combined),
     ):
         val = tot(field)
         if val:
             core[name] = val
     return format_stats(core, mc)
+
+
+def link_stats(st: SimState, config: SystemConfig) -> dict:
+    """Per-link interconnect counters keyed by link name (mirrors
+    LinkTracker.link_stats on the spec side, minus the occupancy
+    histogram — the device step keeps only totals and maxima).
+    Batched states aggregate over the ensemble (max of maxima)."""
+    if not config.interconnect.enabled:
+        return {}
+    from hpa2_tpu.interconnect.topology import build_topology
+
+    topo = build_topology(
+        config.interconnect.topology,
+        config.num_procs,
+        config.interconnect.hop_latency,
+    )
+    trav = np.asarray(st.link_traversals)
+    peak = np.asarray(st.link_max_load)
+    if trav.ndim == 2:
+        trav = trav.sum(axis=0)
+        peak = peak.max(axis=0)
+    return {
+        "traversals": {
+            name: int(trav[i])
+            for i, name in enumerate(topo.link_names)
+            if trav[i]
+        },
+        "max_load": {
+            name: int(peak[i])
+            for i, name in enumerate(topo.link_names)
+            if peak[i]
+        },
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -770,6 +813,11 @@ class BatchJaxEngine:
 
     def stats(self) -> dict:
         return engine_stats(self.state)
+
+    def link_stats(self) -> dict:
+        """Ensemble-aggregated per-link interconnect counters ({}
+        under the ideal topology)."""
+        return link_stats(self.state, self.config)
 
     @property
     def instructions(self) -> int:

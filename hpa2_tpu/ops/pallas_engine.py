@@ -2558,6 +2558,12 @@ class PallasEngine:
         packed: bool = False,
         _ablate: frozenset = frozenset(),
     ):
+        if config.interconnect.enabled:
+            raise ValueError(
+                "the Pallas kernel implements the ideal topology only; "
+                "use the spec or XLA engines for "
+                f"topology={config.interconnect.topology!r}"
+            )
         if interpret is None:
             # the Mosaic kernel path needs a TPU; interpret elsewhere
             # (match on the device, not default_backend(): the axon
@@ -3047,6 +3053,12 @@ class PallasLaneSession:
         packed: bool = False,
         max_cycles: int = 1_000_000,
     ):
+        if config.interconnect.enabled:
+            raise ValueError(
+                "the Pallas kernel implements the ideal topology only; "
+                "use the spec or XLA engines for "
+                f"topology={config.interconnect.topology!r}"
+            )
         if interpret is None:
             interpret = not any(
                 "tpu" in str(d).lower() for d in jax.devices()

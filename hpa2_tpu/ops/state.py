@@ -32,13 +32,39 @@ from hpa2_tpu.utils.trace import IssueRecord
 I32 = jnp.int32
 U32 = jnp.uint32
 
-# mb_data column layout
+# mb_data column layout.  Sharer words occupy [MB_SHARERS, MB_SHARERS+W);
+# non-ideal interconnect builds append one deliver-at column at 5 + W
+# (ideal keeps the exact historical 5 + W row, so ideal states — and
+# their checkpoints — stay byte-identical to pre-topology builds).
 MB_TYPE, MB_SENDER, MB_ADDR, MB_VALUE, MB_SECOND, MB_SHARERS = 0, 1, 2, 3, 4, 5
 
 
-def _mb_empty_row(w: int) -> np.ndarray:
+def mb_width(config: SystemConfig) -> int:
+    """mb_data row width: 5 + sharer words (+ deliver-at column when a
+    non-ideal topology is configured)."""
+    return 5 + config.sharer_words + (1 if config.interconnect.enabled else 0)
+
+
+def num_links(config: SystemConfig) -> int:
+    """Length of the per-link counter planes (>= 1 so ideal states
+    keep a fixed-shape placeholder instead of a zero-width array)."""
+    ic = config.interconnect
+    if not ic.enabled:
+        return 1
+    from hpa2_tpu.interconnect.topology import build_topology
+
+    return max(
+        1, build_topology(ic.topology, config.num_procs,
+                          ic.hop_latency).num_links
+    )
+
+
+def _mb_empty_row(w: int, deliver: bool = False) -> np.ndarray:
     """Packed empty-slot sentinel (type=-1, second=-1)."""
-    return np.array([-1, 0, 0, 0, -1] + [0] * w, dtype=np.int32)
+    return np.array(
+        [-1, 0, 0, 0, -1] + [0] * w + ([0] if deliver else []),
+        dtype=np.int32,
+    )
 
 
 def _mem_init(n: int, m: int) -> np.ndarray:
@@ -123,6 +149,13 @@ class SimState(NamedTuple):
     n_reorder_fixed: jnp.ndarray
     n_delays: jnp.ndarray
     n_wire_stalls: jnp.ndarray  # retry budget exhausted -> deferred
+    # interconnect model counters (hpa2_tpu/interconnect/): per-link
+    # planes are [num_links(config)] ([1] zero placeholders for ideal)
+    link_traversals: jnp.ndarray   # [L] accepted traversals per link
+    link_max_load: jnp.ndarray     # [L] max single-cycle occupancy
+    n_topo_delay: jnp.ndarray      # extra delay cycles beyond ideal
+    n_multicast_saved: jnp.ndarray # link traversals saved by multicast
+    n_combined: jnp.ndarray        # READ_REQUESTs merged in-network
 
 
 def init_state_batched(
@@ -153,6 +186,8 @@ def init_state_batched(
         raise ValueError(f"tr_len out of range 0..{t}")
 
     mem0 = np.broadcast_to(_mem_init(n, m), (b, n, m))
+    topo_on = config.interconnect.enabled
+    links = num_links(config)
     full = lambda shape, val, dt: jnp.full(shape, val, dtype=dt)
     zeros = lambda shape, dt: jnp.zeros(shape, dtype=dt)
     return SimState(
@@ -163,7 +198,8 @@ def init_state_batched(
         dir_state=full((b, n, m), int(DirState.U), I32),
         dir_sharers=zeros((b, n, m, w), U32),
         mb_data=jnp.broadcast_to(
-            jnp.asarray(_mb_empty_row(w)), (b, n, cap, 5 + w)
+            jnp.asarray(_mb_empty_row(w, topo_on)),
+            (b, n, cap, 5 + w + topo_on),
         ),
         mb_count=zeros((b, n), I32),
         pc=zeros((b, n), I32),
@@ -210,6 +246,11 @@ def init_state_batched(
         n_reorder_fixed=zeros((b,), I32),
         n_delays=zeros((b,), I32),
         n_wire_stalls=zeros((b,), I32),
+        link_traversals=zeros((b, links), I32),
+        link_max_load=zeros((b, links), I32),
+        n_topo_delay=zeros((b,), I32),
+        n_multicast_saved=zeros((b,), I32),
+        n_combined=zeros((b,), I32),
     )
 
 
@@ -254,6 +295,8 @@ def init_state(
         order_len = np.int32(-1)  # -1 = free-run
 
     mem0 = _mem_init(n, m)
+    topo_on = config.interconnect.enabled
+    links = num_links(config)
 
     return SimState(
         cache_addr=jnp.full((n, c), INVALID_ADDR, dtype=I32),
@@ -263,7 +306,8 @@ def init_state(
         dir_state=jnp.full((n, m), int(DirState.U), dtype=I32),
         dir_sharers=jnp.zeros((n, m, w), dtype=U32),
         mb_data=jnp.broadcast_to(
-            jnp.asarray(_mb_empty_row(w)), (n, cap, 5 + w)
+            jnp.asarray(_mb_empty_row(w, topo_on)),
+            (n, cap, 5 + w + topo_on),
         ),
         mb_count=jnp.zeros((n,), dtype=I32),
         pc=jnp.zeros((n,), dtype=I32),
@@ -308,4 +352,9 @@ def init_state(
         n_reorder_fixed=jnp.zeros((), dtype=I32),
         n_delays=jnp.zeros((), dtype=I32),
         n_wire_stalls=jnp.zeros((), dtype=I32),
+        link_traversals=jnp.zeros((links,), dtype=I32),
+        link_max_load=jnp.zeros((links,), dtype=I32),
+        n_topo_delay=jnp.zeros((), dtype=I32),
+        n_multicast_saved=jnp.zeros((), dtype=I32),
+        n_combined=jnp.zeros((), dtype=I32),
     )

@@ -50,6 +50,8 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -219,6 +221,37 @@ def build_step(
     n_local = n // shards
     local_ids = jnp.arange(n_local, dtype=I32)
 
+    # -- interconnect topology (static: ideal builds add zero ops and
+    # keep the exact pre-topology mb_data layout / op counts) ---------
+    ic = config.interconnect
+    topo_on = ic.enabled
+    if topo_on:
+        if axis_name is not None:
+            raise ValueError(
+                "non-ideal interconnect topologies run single-shard "
+                "only; node sharding composes with topology='ideal'"
+            )
+        if replay:
+            raise ValueError(
+                "replay mode supports the ideal topology only"
+            )
+        from hpa2_tpu.interconnect.topology import build_topology
+
+        topo = build_topology(ic.topology, n, ic.hop_latency)
+        # flat candidate order is sender-static: A grid (3 slots per
+        # node, node-major) then B grid (2 slots per node) — bake the
+        # per-candidate path/latency tensors as jit constants
+        send_np = np.concatenate(
+            [np.repeat(np.arange(n), 3), np.repeat(np.arange(n), 2)]
+        )
+        paths_np = topo.path_mat[send_np]  # [J0, N, L] bool
+        if paths_np.shape[2] == 0:  # linkless (n == 1): keep L >= 1
+            paths_np = np.zeros((5 * n, n, 1), dtype=bool)
+        # ideal-equivalent minimum is one cycle (next-cycle handling)
+        base_np = np.maximum(topo.base_lat[send_np], 1).astype(np.int32)
+        n_links = paths_np.shape[2]
+        mb_deliver = 5 + w  # deliver-at column (after sharer words)
+
     def step(st: SimState) -> SimState:
         if axis_name is None:
             node_ids = local_ids
@@ -236,13 +269,20 @@ def build_step(
         # head is always slot 0 (shift-down queue): reads are static
         # slices — a fused gather would be scalarized by the TPU
         # backend (measured ~1000x slower than this formulation)
-        has_msg = (st.mb_count > 0) & ~blocked
         hm = st.mb_data[:, 0, :]
+        has_msg = (st.mb_count > 0) & ~blocked
+        if topo_on:
+            # interconnect gating: the head blocks until its delivery
+            # cycle (FIFO order preserved — an ordered virtual channel,
+            # mirrors the spec engine's mailbox[0].deliver_at check)
+            has_msg = has_msg & (hm[:, mb_deliver] <= st.cycle)
         mt = jnp.where(has_msg, hm[:, MB_TYPE], _NO_MSG)
         snd = hm[:, MB_SENDER]
         a = jnp.maximum(hm[:, MB_ADDR], 0)
         v = hm[:, MB_VALUE]
-        msh = jax.lax.bitcast_convert_type(hm[:, MB_SHARERS:], U32)
+        msh = jax.lax.bitcast_convert_type(
+            hm[:, MB_SHARERS : MB_SHARERS + w], U32
+        )
         sr = hm[:, MB_SECOND]
 
         # consume the head: shift the queue down one slot
@@ -903,6 +943,70 @@ def build_step(
         accept_rj = valid_ok & (offs < avail[:, None])
         delivered = jnp.sum(accept_rj.astype(I32), axis=1)
 
+        # -- interconnect delays (static no-op for the ideal topology) -
+        # every ACCEPTED message is charged base path latency plus the
+        # per-link queueing penalty of finite bandwidth, computed over
+        # the same global walk order the spec engine's _deliver uses
+        # (flat candidate-major, receiver-minor = (phase, sender,
+        # emission, receiver-ascending)).  Contention is memoryless per
+        # cycle, so the whole computation is a pure function of this
+        # cycle's accept mask — exactly LinkTracker.on_accept, but
+        # vectorized: an exclusive cumsum over the flat walk replaces
+        # the sequential per-link load counters.
+        if topo_on:
+            paths_c = jnp.asarray(paths_np)              # [J, N, L]
+            base_c = jnp.asarray(base_np)                # [J, N]
+            acc_jr = accept_rj.T                         # [J, N]
+            use = acc_jr[:, :, None] & paths_c           # [J, N, L]
+            contrib = use
+            mc_saved_inc = comb_inc = jnp.zeros((), dtype=I32)
+            if ic.multicast:
+                # one INV payload per shared link: within a fan-out
+                # only the first receiver (ascending) to touch a link
+                # contributes; riders still queue behind that single
+                # traversal (their penalty prefix includes it)
+                u_i = use.astype(I32)
+                prior_r = jnp.cumsum(u_i, axis=1) - u_i
+                saved = use & f["is_inv"][:, None, None] & (prior_r > 0)
+                contrib = contrib & ~saved
+                mc_saved_inc = jnp.sum(saved.astype(I32))
+            if ic.combining:
+                # same-address READ_REQUESTs merge in-network: only the
+                # first accepted request per address traverses; merged
+                # riders contribute zero occupancy on every link
+                jidx = jnp.arange(j, dtype=I32)
+                acc_any = jnp.any(acc_jr, axis=1)
+                is_read = acc_any & (
+                    f["type"] == int(MsgType.READ_REQUEST)
+                )
+                tbl = jnp.full((n * m,), j, dtype=I32).at[
+                    f["addr"]
+                ].min(jnp.where(is_read, jidx, j))
+                merged_rd = is_read & (tbl[f["addr"]] != jidx)
+                contrib = contrib & ~merged_rd[:, None, None]
+                comb_inc = jnp.sum(merged_rd.astype(I32))
+            c_flat = contrib.reshape(j * n_local, n_links).astype(I32)
+            prefix = jnp.cumsum(c_flat, axis=0) - c_flat  # exclusive
+            pen_flat = jnp.sum(
+                (prefix // ic.link_bandwidth)
+                * use.reshape(j * n_local, n_links).astype(I32),
+                axis=1,
+            )
+            penalty = pen_flat.reshape(j, n_local)       # [J, N]
+            deliver_rj = (st.cycle + base_c + penalty).T  # [N, J]
+            load_l = jnp.sum(c_flat, axis=0)             # [L]
+            link_traversals = st.link_traversals + load_l
+            link_max_load = jnp.maximum(st.link_max_load, load_l)
+            topo_delay_inc = jnp.sum(
+                jnp.where(acc_jr, base_c - 1 + penalty, 0)
+            )
+        else:
+            link_traversals = st.link_traversals
+            link_max_load = st.link_max_load
+            topo_delay_inc = mc_saved_inc = comb_inc = jnp.zeros(
+                (), dtype=I32
+            )
+
         # TPU gathers/scatters fused into this graph get scalarized
         # (measured ms-scale); deliver instead by one-hot placement:
         # candidate j lands at queue slot count2 + offs — a dense
@@ -939,6 +1043,13 @@ def build_step(
             | (pl[..., 3 * nf : 4 * nf] << 24)
         )
         placed = jax.lax.bitcast_convert_type(placed_u, I32)  # [N, cap, F]
+        if topo_on:
+            # the deliver-at column carries cycle magnitudes the bf16
+            # byte-plane trick can't represent exactly; place it with a
+            # separate int32 one-hot contraction (at most one candidate
+            # hot per slot, so the sum has one term — exact)
+            dcol = jnp.einsum("ncj,nj->nc", hot.astype(I32), deliver_rj)
+            placed = jnp.concatenate([placed, dcol[:, :, None]], axis=2)
         krel = slot[None, :] - mb_count2[:, None]
         write = (krel >= 0) & (krel < delivered[:, None])
         mb_data = jnp.where(write[:, :, None], placed, qdata)
@@ -1164,6 +1275,11 @@ def build_step(
             n_reorder_fixed=st.n_reorder_fixed + reo_inc,
             n_delays=st.n_delays + del_inc,
             n_wire_stalls=st.n_wire_stalls + wstall_inc,
+            link_traversals=link_traversals,
+            link_max_load=link_max_load,
+            n_topo_delay=st.n_topo_delay + topo_delay_inc,
+            n_multicast_saved=st.n_multicast_saved + mc_saved_inc,
+            n_combined=st.n_combined + comb_inc,
         )
 
     return step

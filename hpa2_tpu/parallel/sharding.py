@@ -59,7 +59,11 @@ _NODE_LEADING = frozenset(
                  "n_write_miss", "n_evictions", "n_invalidations",
                  "msg_counts", "rng_key", "last_progress",
                  "n_retrans", "n_dup_filtered", "n_reorder_fixed",
-                 "n_delays", "n_wire_stalls")
+                 "n_delays", "n_wire_stalls",
+                 # interconnect fields lead with the link axis (or are
+                 # scalar counters), never the node axis
+                 "link_traversals", "link_max_load", "n_topo_delay",
+                 "n_multicast_saved", "n_combined")
 )
 
 
@@ -218,6 +222,11 @@ class NodeShardedEngine:
     ):
         if mesh is None:
             mesh = make_mesh(node_shards=len(jax.devices()))
+        if config.interconnect.enabled:
+            raise ValueError(
+                "non-ideal interconnect topologies run single-shard "
+                "only; node sharding composes with topology='ideal'"
+            )
         if config.num_procs % mesh.shape["node"] != 0:
             raise ValueError(
                 f"num_procs={config.num_procs} not divisible by node "
@@ -284,6 +293,11 @@ class GridEngine:
     ):
         if mesh is None:
             mesh = make_mesh(node_shards=1)
+        if config.interconnect.enabled:
+            raise ValueError(
+                "non-ideal interconnect topologies run single-shard "
+                "only; the grid engine composes with topology='ideal'"
+            )
         b = len(batch_traces)
         if b % mesh.shape["data"] != 0:
             raise ValueError(

@@ -26,7 +26,12 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from hpa2_tpu.config import FaultModel, Semantics, SystemConfig
+from hpa2_tpu.config import (
+    FaultModel,
+    InterconnectConfig,
+    Semantics,
+    SystemConfig,
+)
 from hpa2_tpu.ops.state import SimState
 
 _MAGIC = "hpa2_checkpoint_v1"
@@ -41,8 +46,12 @@ def _config_to_json(config: SystemConfig) -> str:
 def _config_from_json(text: str) -> SystemConfig:
     d = json.loads(text)
     d["semantics"] = Semantics(**d["semantics"])
-    if "fault" in d:
+    if d.get("fault") is not None:
         d["fault"] = FaultModel(**d["fault"])
+    if "interconnect" in d:  # absent in pre-topology checkpoints
+        ic = dict(d["interconnect"])
+        ic["fault"] = FaultModel(**ic["fault"])
+        d["interconnect"] = InterconnectConfig(**ic)
     return SystemConfig(**d)
 
 
@@ -106,14 +115,18 @@ def load_state(path: str, with_meta: bool = False):
 
 def _msg_to_list(m) -> list:
     return [int(m.type), m.sender, m.address, m.value, m.sharers,
-            m.second_receiver]
+            m.second_receiver, m.deliver_at]
 
 
 def _msg_from_list(row) -> "object":
     from hpa2_tpu.models.protocol import Message, MsgType
 
-    t, sender, address, value, sharers, second = row
-    return Message(MsgType(t), sender, address, value, sharers, second)
+    # pre-topology checkpoints have 6-element rows (no deliver_at)
+    t, sender, address, value, sharers, second = row[:6]
+    msg = Message(MsgType(t), sender, address, value, sharers, second)
+    if len(row) > 6:
+        msg.deliver_at = row[6]
+    return msg
 
 
 def _dump_to_dict(d) -> dict:
@@ -173,6 +186,10 @@ def save_spec_state(path: str, engine) -> None:
         "fault_rng": (
             None if engine._fault_rng is None
             else list(engine._fault_rng.getstate())
+        ),
+        "link_tracker": (
+            None if engine.link_tracker is None
+            else engine.link_tracker.dump_state()
         ),
         "nodes": [
             {
@@ -245,6 +262,8 @@ def load_spec_state(path: str):
     if doc["fault_rng"] is not None:
         st = doc["fault_rng"]
         engine._fault_rng.setstate((st[0], tuple(st[1]), st[2]))
+    if doc.get("link_tracker") is not None:
+        engine.link_tracker.load_state(doc["link_tracker"])
     for node, nd in zip(engine.nodes, doc["nodes"]):
         node.memory = list(nd["memory"])
         for entry, (ds, sharers) in zip(node.directory, nd["dir"]):

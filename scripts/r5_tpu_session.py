@@ -52,6 +52,11 @@ Steps, in value order:
                      p50/p99 job latency under Poisson and heavy-tail
                      arrivals, with the pipelined-vs-serial staging
                      overlap split
+  topo512            interconnect sensitivity study at a 16-node x
+                     24-round invalidation storm (bench.py --topology
+                     with HPA2_TOPO_NODES/ROUNDS): rewrites
+                     TOPO_r11.json with indicative:true numbers and
+                     the spec<->jax agreement verdicts
 
 All measure() steps run the HBM-streaming run program (PallasEngine
 default stream=True since the VMEM-wall PR).
@@ -598,6 +603,23 @@ def main() -> int:
                 timeout_s=3600, argv=True))
         finally:
             os.environ.pop("HPA2_SERVE_RESIDENT", None)
+
+    if "topo512" not in skip and gate("topo512"):
+        # ISSUE-11: the interconnect sensitivity study at a larger
+        # storm than the shipped TOPO_r11.json default (the spec
+        # engine anchors the numbers, so node count stays modest) —
+        # rewrites TOPO_r11.json with indicative:true numbers plus
+        # the per-topology spec<->jax agreement verdicts
+        os.environ["HPA2_TOPO_NODES"] = "16"
+        os.environ["HPA2_TOPO_ROUNDS"] = "24"
+        try:
+            note(run_py(
+                "topo512",
+                [os.path.join(REPO, "bench.py"), "--topology"],
+                timeout_s=1800, argv=True))
+        finally:
+            os.environ.pop("HPA2_TOPO_NODES", None)
+            os.environ.pop("HPA2_TOPO_ROUNDS", None)
 
     if "multichip" not in skip and gate("multichip"):
         # full data_shards ladder + bit-exactness gate; rewrites

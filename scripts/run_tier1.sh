@@ -78,6 +78,41 @@ then
   echo "TIER1: node-shard smoke failed" >&2
   exit 1
 fi
+# Interconnect smoke (~20s, CPU): the ISSUE-11 topology model — the
+# `analysis topology` sensitivity table must render, and an explicit
+# topology="ideal" config (with inert non-default knobs) must stay
+# bit-exact against the default pre-topology config on both the spec
+# and jax engines.  Catches delivery-gate wiring breaks cheaply.
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python - > /dev/null <<'EOF'
+import dataclasses
+from hpa2_tpu.analysis.topology import topology_table
+from hpa2_tpu.config import InterconnectConfig, Semantics, SystemConfig
+from hpa2_tpu.models.spec_engine import SpecEngine
+from hpa2_tpu.ops.engine import JaxEngine
+from hpa2_tpu.utils.trace import gen_uniform_random
+
+out = topology_table(nodes=4, rounds=2, topologies=["mesh2d"])
+assert "unicast" in out and "mcast+comb" in out
+
+cfg = SystemConfig(num_procs=4, max_instr_num=0,
+                   semantics=Semantics().robust())
+alt = dataclasses.replace(cfg, interconnect=InterconnectConfig(
+    topology="ideal", hop_latency=5, link_bandwidth=2))
+traces = gen_uniform_random(cfg, 20, seed=3)
+ref = JaxEngine(cfg, traces).run()
+got = JaxEngine(alt, traces).run()
+spec = SpecEngine(alt, [list(t) for t in traces])
+spec.run()
+as_dicts = lambda dumps: [d.__dict__ for d in dumps]
+assert as_dicts(got.final_dumps()) == as_dicts(ref.final_dumps())
+assert as_dicts(spec.final_dumps()) == as_dicts(ref.final_dumps())
+assert got.cycle == ref.cycle == spec.cycle
+assert got.stats() == ref.stats()
+EOF
+then
+  echo "TIER1: interconnect smoke failed" >&2
+  exit 1
+fi
 # Serving smoke (~30s, CPU interpret): the ISSUE-10 always-on loop —
 # a short Poisson feed admitted into resident lanes must produce
 # byte-identical dumps to the one-shot scheduled batch run, with

@@ -1,0 +1,564 @@
+"""Interconnect topology model (hpa2_tpu/interconnect/).
+
+Gates for the deterministic contention model:
+
+  (1) registry — compiled topologies have the advertised shapes,
+      routing (XY columns-first, torus shorter-way wraps, two-tier
+      hierarchical), and validation;
+  (2) a hand-computed mesh2d case pinning EXACT delivery cycles out
+      of the sequential LinkTracker reference, variant by variant;
+  (3) ``topology="ideal"`` is byte-identical to the pre-topology
+      engines in every mode (plain, fused/batched, packed Pallas,
+      data-sharded, faulty) even when the rest of the interconnect
+      config differs;
+  (4) spec <-> JAX agreement (dumps, cycles, counters, per-link
+      stats) under contention, multicast, and combining;
+  (5) the one-stats-schema pin: fault/topology counters appear only
+      when nonzero, and fault delay/retransmission counts surface in
+      engine stats and the StallDiagnostic;
+  (6) checkpoint round-trips carry the ``deliver_at`` lane;
+  (7) backends without a topology implementation refuse non-ideal
+      configs loudly (Pallas, node-sharded, replay, CLI);
+  (8) the interconnect-purity lint rule fires on RNG/clock imports
+      and the repo itself is clean.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from hpa2_tpu.config import (
+    FaultModel,
+    InterconnectConfig,
+    Semantics,
+    SystemConfig,
+)
+from hpa2_tpu.interconnect import LinkTracker, TOPOLOGIES, build_topology
+from hpa2_tpu.models.spec_engine import SpecEngine
+from hpa2_tpu.ops.engine import BatchJaxEngine, JaxEngine, stall_diagnostic
+from hpa2_tpu.utils.trace import (
+    gen_uniform_random,
+    gen_uniform_random_arrays,
+)
+
+ROBUST = Semantics().robust()
+
+
+def _dumps_equal(a, b):
+    return [dataclasses.asdict(x) for x in a] == [
+        dataclasses.asdict(y) for y in b
+    ]
+
+
+def _stats_agree(a, b):
+    # zero-tolerant: the spec omits never-incremented keys, the device
+    # schema always carries the core counters (test_observability.py)
+    for key in set(a) | set(b):
+        assert a.get(key, 0) == b.get(key, 0), key
+
+
+def _mesh_cfg(topo="mesh2d", procs=8, **kw):
+    return SystemConfig(
+        num_procs=procs,
+        max_instr_num=0,
+        semantics=ROBUST,
+        interconnect=InterconnectConfig(topology=topo, **kw),
+    )
+
+
+# -- (1) topology registry ------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["mesh2d", "torus2d", "hierarchical"])
+@pytest.mark.parametrize("n", [4, 8])
+def test_registry_shapes(name, n):
+    t = build_topology(name, n)
+    L = t.num_links
+    assert t.path_mat.shape == (n, n, L)
+    assert t.hops.shape == (n, n) and t.base_lat.shape == (n, n)
+    assert np.array_equal(np.diag(t.hops), np.zeros(n))
+    assert np.array_equal(np.diag(t.base_lat), np.zeros(n))
+    # path incidence is consistent: row sums == hop counts, and with
+    # hop_latency=1 grids the base latency equals the hop count
+    assert np.array_equal(t.path_mat.sum(axis=2), t.hops)
+    if name != "hierarchical":
+        assert np.array_equal(t.base_lat, t.hops)
+    # routed paths are direction-symmetric in length
+    assert np.array_equal(t.hops, t.hops.T)
+
+
+def test_mesh2d_2x2_routing():
+    # 2x2 grid: 0 1 / 2 3; XY routing goes columns first, then rows
+    t = build_topology("mesh2d", 4)
+    assert t.num_links == 8  # 4 undirected edges, one link per direction
+    i01 = t.link_names.index("n0->n1")
+    i13 = t.link_names.index("n1->n3")
+    assert t.base_lat[0, 3] == 2
+    assert t.path_mat[0, 3, i01] and t.path_mat[0, 3, i13]
+    assert t.path_mat[0, 3].sum() == 2
+
+
+def test_torus_wraps_the_shorter_way():
+    # 1x3 ring: 0 -> 2 is one hop backwards on the torus, two on the mesh
+    assert build_topology("torus2d", 3).hops[0, 2] == 1
+    assert build_topology("mesh2d", 3).hops[0, 2] == 2
+    # 4x4 torus: distance 2 along a row is a tie; ties break positive
+    t = build_topology("torus2d", 16)
+    assert t.path_mat[0, 2, t.link_names.index("n0->n1")]
+    assert not t.path_mat[0, 2, t.link_names.index("n0->n3")]
+
+
+def test_hierarchical_two_tier():
+    # n=8 -> 2 groups of 4: up/down links per node + 2 switch links
+    t = build_topology("hierarchical", 8)
+    assert t.num_links == 8 * 2 + 2
+    assert t.base_lat[0, 1] == 2        # n0->s0, s0->n1
+    assert t.base_lat[0, 7] == 1 + 4 + 1  # DCN tier costs 4x
+    assert t.hops[0, 7] == 3
+
+
+def test_build_topology_validation_and_cache():
+    with pytest.raises(ValueError, match="unknown topology"):
+        build_topology("ring", 4)
+    with pytest.raises(ValueError, match="n >= 1"):
+        build_topology("mesh2d", 0)
+    with pytest.raises(ValueError, match="hop_latency"):
+        build_topology("mesh2d", 4, hop_latency=0)
+    assert build_topology("ideal", 4).num_links == 0
+    # cached: jit caches key on config, so tensor identity matters
+    assert build_topology("mesh2d", 8) is build_topology("mesh2d", 8)
+
+
+# -- (2) hand-computed mesh2d delivery cycles -----------------------------
+
+
+def _accept(tr, cycle, s, d, inv=False, read=False, addr=0):
+    return tr.on_accept(cycle, s, d, 0, addr, inv, read)
+
+
+def test_linktracker_mesh2d_hand_computed():
+    """2x2 mesh, bandwidth 1: four messages accepted in walk order in
+    cycle 10.  Paths: 0->3 = [n0->n1, n1->n3], 1->3 = [n1->n3],
+    2->3 = [n2->n3]."""
+    t = build_topology("mesh2d", 4)
+    tr = LinkTracker(t)
+    tr.begin_cycle()
+    # empty links: delay is the pure base latency
+    assert _accept(tr, 10, 0, 3) == 12          # base 2, penalty 0
+    # n1->n3 already carries one message -> queues one cycle behind it
+    assert _accept(tr, 10, 1, 3) == 12          # base 1, penalty 1
+    assert _accept(tr, 10, 2, 3) == 11          # untouched link
+    # second 0->3: one prior on n0->n1, two prior on n1->n3
+    assert _accept(tr, 10, 0, 3) == 15          # base 2, penalty 1+2
+    tr.end_cycle()
+    assert tr.n_topo_delay == (2 - 1) + (2 - 1) + (1 - 1) + (5 - 1)
+    assert int(tr.max_load[t.link_names.index("n1->n3")]) == 3
+    assert int(tr.traversals[t.link_names.index("n0->n1")]) == 2
+
+
+def test_linktracker_bandwidth_absorbs_contention():
+    tr = LinkTracker(build_topology("mesh2d", 4), bandwidth=2)
+    tr.begin_cycle()
+    assert _accept(tr, 10, 1, 3) == 11
+    # one prior traversal // bw 2 = 0 extra cycles
+    assert _accept(tr, 10, 1, 3) == 11
+    assert _accept(tr, 10, 1, 3) == 12          # 2 // 2 = 1
+    tr.end_cycle()
+
+
+def test_linktracker_multicast_shares_links():
+    """INV fan-out from node 0 to 1, 2, 3: the 0->3 leg rides the
+    already-traversed n0->n1 link (saved) but still queues behind the
+    group's single transfer on it."""
+    t = build_topology("mesh2d", 4)
+    tr = LinkTracker(t, multicast=True)
+    tr.begin_cycle()
+    assert _accept(tr, 10, 0, 1, inv=True, addr=5) == 11
+    assert _accept(tr, 10, 0, 2, inv=True, addr=5) == 11
+    assert _accept(tr, 10, 0, 3, inv=True, addr=5) == 13  # base 2 + 1
+    tr.end_cycle()
+    assert tr.n_multicast_saved == 1
+    assert int(tr.traversals[t.link_names.index("n0->n1")]) == 1
+
+
+def test_linktracker_combining_merges_reads():
+    t = build_topology("mesh2d", 4)
+    tr = LinkTracker(t, combining=True)
+    tr.begin_cycle()
+    assert _accept(tr, 10, 1, 0, read=True, addr=9) == 11
+    # same-address read merges: zero occupancy contribution, still
+    # delivered at its own base latency (3->0 = [n3->n2, n2->n0])
+    assert _accept(tr, 10, 3, 0, read=True, addr=9) == 12
+    tr.end_cycle()
+    assert tr.n_combined == 1
+    assert int(tr.traversals.sum()) == 1        # only the first read
+
+
+# -- config surface -------------------------------------------------------
+
+
+def test_interconnect_config_validation():
+    with pytest.raises(ValueError, match="unknown topology"):
+        InterconnectConfig(topology="ring")
+    with pytest.raises(ValueError, match="non-ideal"):
+        InterconnectConfig(topology="ideal", multicast=True)
+    with pytest.raises(ValueError, match="link_bandwidth"):
+        InterconnectConfig(topology="mesh2d", link_bandwidth=0)
+    assert not InterconnectConfig().enabled
+    assert InterconnectConfig(topology="mesh2d").enabled
+
+
+def test_legacy_fault_alias_folds_into_interconnect():
+    # SystemConfig(fault=...) is the deprecated spelling of
+    # SystemConfig(interconnect=InterconnectConfig(fault=...))
+    legacy = SystemConfig(fault=FaultModel(drop=0.5, seed=3))
+    assert legacy.interconnect.fault.drop == 0.5
+    assert legacy.fault == legacy.interconnect.fault
+    nested = SystemConfig(
+        interconnect=InterconnectConfig(fault=FaultModel(drop=0.5, seed=3))
+    )
+    assert legacy.interconnect == nested.interconnect
+    with pytest.raises(ValueError, match="both"):
+        SystemConfig(
+            fault=FaultModel(drop=0.5),
+            interconnect=InterconnectConfig(fault=FaultModel(drop=0.25)),
+        )
+
+
+# -- (3) ideal is byte-identical to the pre-topology engines --------------
+
+# a distinct config object that still takes the ideal path: every
+# other interconnect knob must be inert when topology == "ideal"
+_IDEAL_VARIANT = InterconnectConfig(
+    topology="ideal", hop_latency=7, link_bandwidth=3
+)
+
+
+def test_ideal_byte_identity_plain():
+    cfg = SystemConfig(num_procs=8, max_instr_num=0, semantics=ROBUST)
+    alt = dataclasses.replace(cfg, interconnect=_IDEAL_VARIANT)
+    traces = gen_uniform_random(cfg, 40, seed=2)
+    ref = JaxEngine(cfg, traces).run()
+    got = JaxEngine(alt, traces).run()
+    assert _dumps_equal(ref.snapshots(), got.snapshots())
+    assert _dumps_equal(ref.final_dumps(), got.final_dumps())
+    assert ref.cycle == got.cycle
+    assert ref.stats() == got.stats()
+    assert got.link_stats() == {}
+    spec = SpecEngine(alt, [list(t) for t in traces])
+    spec.run()
+    assert _dumps_equal(spec.final_dumps(), got.final_dumps())
+    assert spec.link_tracker is None
+
+
+def test_ideal_byte_identity_batched():
+    cfg = SystemConfig(num_procs=4, max_instr_num=0, semantics=ROBUST)
+    alt = dataclasses.replace(cfg, interconnect=_IDEAL_VARIANT)
+    batch = [gen_uniform_random(cfg, 16, seed=s) for s in range(3)]
+    ref = BatchJaxEngine(cfg, batch).run()
+    got = BatchJaxEngine(alt, batch).run()
+    for s in range(len(batch)):
+        assert _dumps_equal(
+            ref.system_final_dumps(s), got.system_final_dumps(s)
+        )
+    assert ref.stats() == got.stats()
+    assert got.link_stats() == {}
+
+
+def test_ideal_byte_identity_packed_pallas():
+    from hpa2_tpu.ops.pallas_engine import PallasEngine
+
+    kw = dict(block=4, cycles_per_call=32, trace_window=8, gate=True)
+    cfg = SystemConfig(num_procs=4, semantics=ROBUST)
+    alt = dataclasses.replace(cfg, interconnect=_IDEAL_VARIANT)
+    arrays = gen_uniform_random_arrays(cfg, 4, 8, seed=0)
+    ref = PallasEngine(cfg, *arrays, packed=True, **kw).run()
+    got = PallasEngine(alt, *arrays, packed=True, **kw).run()
+    for f, v in ref.state.items():
+        assert np.array_equal(np.asarray(v), np.asarray(got.state[f])), f
+    assert ref.cycle == got.cycle
+    assert ref.stats() == got.stats()
+
+
+@pytest.mark.virtual_mesh
+def test_ideal_byte_identity_data_sharded():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    cfg = SystemConfig(num_procs=4, max_instr_num=0, semantics=ROBUST)
+    alt = dataclasses.replace(cfg, interconnect=_IDEAL_VARIANT)
+    batch = [gen_uniform_random(cfg, 12, seed=s) for s in range(8)]
+    ref = BatchJaxEngine(cfg, batch, data_shards=8).run()
+    got = BatchJaxEngine(alt, batch, data_shards=8).run()
+    for s in range(8):
+        assert _dumps_equal(
+            ref.system_final_dumps(s), got.system_final_dumps(s)
+        )
+    assert ref.stats() == got.stats()
+
+
+def test_ideal_byte_identity_faulty():
+    fault = FaultModel(drop=0.2, duplicate=0.05, reorder=0.05,
+                       delay=0.1, seed=7)
+    cfg = SystemConfig(
+        num_procs=4, max_instr_num=0, semantics=ROBUST, fault=fault
+    )
+    alt = dataclasses.replace(
+        cfg,
+        fault=None,
+        interconnect=dataclasses.replace(_IDEAL_VARIANT, fault=fault),
+    )
+    batch = [gen_uniform_random(cfg, 16, seed=s) for s in range(2)]
+    ref = BatchJaxEngine(cfg, batch).run()
+    got = BatchJaxEngine(alt, batch).run()
+    for s in range(len(batch)):
+        assert _dumps_equal(
+            ref.system_final_dumps(s), got.system_final_dumps(s)
+        )
+    assert ref.stats() == got.stats()
+    assert ref.stats().get("fault_retransmissions", 0) > 0
+
+
+# -- (4) spec <-> JAX agreement under contention --------------------------
+
+_TOPO_CASES = [
+    ("mesh2d", {}),
+    ("mesh2d", {"multicast": True, "combining": True}),
+    ("mesh2d", {"link_bandwidth": 2, "combining": True}),
+    ("torus2d", {}),
+    ("torus2d", {"multicast": True}),
+    ("torus2d", {"multicast": True, "combining": True}),
+    ("hierarchical", {}),
+    ("hierarchical", {"multicast": True, "combining": True}),
+]
+
+
+@pytest.mark.parametrize("topo,kw", _TOPO_CASES,
+                         ids=[f"{t}-{'-'.join(k) or 'unicast'}"
+                              for t, k in _TOPO_CASES])
+def test_spec_jax_topology_agreement(topo, kw):
+    cfg = _mesh_cfg(topo, **kw)
+    traces = gen_uniform_random(cfg, 30, seed=1)
+    spec = SpecEngine(cfg, [list(t) for t in traces])
+    spec.run()
+    jx = JaxEngine(cfg, traces).run()
+    assert _dumps_equal(spec.snapshots(), jx.snapshots())
+    assert _dumps_equal(spec.final_dumps(), jx.final_dumps())
+    assert spec.cycle == jx.cycle
+    _stats_agree(dict(spec.stats()), jx.stats())
+    sl, jl = spec.link_stats(), jx.link_stats()
+    assert sl["traversals"] == jl["traversals"]
+    assert sl["max_load"] == jl["max_load"]
+
+
+def test_topology_batch_lanes_match_singles():
+    cfg = _mesh_cfg("mesh2d", procs=4, multicast=True)
+    batch = [gen_uniform_random(cfg, 14, seed=s) for s in range(3)]
+    be = BatchJaxEngine(cfg, batch).run()
+    for s, traces in enumerate(batch):
+        one = JaxEngine(cfg, traces).run()
+        assert _dumps_equal(be.system_final_dumps(s), one.final_dumps())
+
+
+def test_topology_delays_actually_bite():
+    """The non-ideal run must cost cycles and say so in the counters —
+    guards against the gate silently short-circuiting to ideal."""
+    cfg = _mesh_cfg("hierarchical")
+    traces = gen_uniform_random(cfg, 30, seed=1)
+    ideal = JaxEngine(
+        dataclasses.replace(cfg, interconnect=InterconnectConfig()), traces
+    ).run()
+    topo = JaxEngine(cfg, traces).run()
+    assert topo.cycle > ideal.cycle
+    assert topo.stats()["topo_delay_cycles"] > 0
+    assert sum(topo.link_stats()["traversals"].values()) > 0
+
+
+def test_analysis_topology_table_renders():
+    from hpa2_tpu.analysis.topology import topology_table
+
+    out = topology_table(nodes=4, rounds=2, topologies=["mesh2d"])
+    assert "invalidation storm" in out
+    assert "unicast" in out and "mcast+comb" in out
+    # deterministic: the exact same table twice
+    assert out == topology_table(nodes=4, rounds=2, topologies=["mesh2d"])
+
+
+# -- (5) stats schema pin -------------------------------------------------
+
+
+def test_stats_schema_only_when_nonzero():
+    cfg = SystemConfig(num_procs=4, max_instr_num=0, semantics=ROBUST)
+    traces = gen_uniform_random(cfg, 16, seed=0)
+    clean = JaxEngine(cfg, traces).run().stats()
+    assert not any(k.startswith(("fault_", "topo_")) for k in clean)
+
+    topo = JaxEngine(_mesh_cfg(procs=4), traces).run().stats()
+    assert topo["topo_delay_cycles"] > 0
+    assert not any(k.startswith("fault_") for k in topo)
+
+
+def test_fault_delay_counters_surface():
+    fault = FaultModel(drop=0.2, duplicate=0.05, reorder=0.05,
+                       delay=0.2, seed=5)
+    cfg = SystemConfig(
+        num_procs=4, max_instr_num=0, semantics=ROBUST,
+        interconnect=InterconnectConfig(fault=fault),
+    )
+    eng = JaxEngine(cfg, gen_uniform_random(cfg, 24, seed=0)).run()
+    stats = eng.stats()
+    assert stats["fault_retransmissions"] > 0
+    assert stats["fault_delays"] > 0
+    # the same counters ride along in the stall post-mortem
+    diag = stall_diagnostic(cfg, eng.state, "schema pin")
+    assert diag.counters["fault_delays"] == stats["fault_delays"]
+    assert (diag.counters["fault_retransmissions"]
+            == stats["fault_retransmissions"])
+
+
+# -- (6) checkpoints carry deliver_at -------------------------------------
+
+
+def test_checkpoint_round_trip_with_topology(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from hpa2_tpu.ops.engine import (
+        build_batched_run,
+        build_batched_run_chunk,
+    )
+    from hpa2_tpu.ops.state import SimState, init_state_batched
+    from hpa2_tpu.ops.step import quiescent
+    from hpa2_tpu.utils.checkpoint import load_state, save_state
+
+    cfg = _mesh_cfg(procs=4, multicast=True)
+    arrays = gen_uniform_random_arrays(cfg, 2, 12, seed=0)
+    straight = build_batched_run(cfg, max_cycles=100_000)(
+        init_state_batched(cfg, *arrays)
+    )
+    chunk = build_batched_run_chunk(cfg, 5)
+    st = chunk(init_state_batched(cfg, *arrays))
+    path = str(tmp_path / "topo.npz")
+    save_state(path, st, cfg)
+    resumed, loaded_cfg = load_state(path)
+    assert loaded_cfg == cfg  # incl. the nested InterconnectConfig
+    while not bool(jnp.all(jax.vmap(quiescent)(resumed))):
+        resumed = chunk(resumed)
+    for name, a, b in zip(SimState._fields, straight, resumed):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), name
+
+
+def test_spec_checkpoint_round_trip_with_topology(tmp_path):
+    from hpa2_tpu.utils.checkpoint import load_spec_state, save_spec_state
+
+    cfg = _mesh_cfg(procs=4)
+    traces = gen_uniform_random(cfg, 12, seed=4)
+    straight = SpecEngine(cfg, [list(t) for t in traces])
+    straight.run()
+
+    eng = SpecEngine(cfg, [list(t) for t in traces])
+    for _ in range(7):
+        eng.step()
+    path = str(tmp_path / "spec.json")
+    save_spec_state(path, eng)
+    resumed = load_spec_state(path)
+    resumed.run()
+    assert _dumps_equal(straight.final_dumps(), resumed.final_dumps())
+    assert straight.cycle == resumed.cycle
+    _stats_agree(dict(straight.stats()), dict(resumed.stats()))
+    assert straight.link_stats() == resumed.link_stats()
+
+
+def test_message_row_format_accepts_pre_topology_rows():
+    from hpa2_tpu.models.protocol import Message, MsgType
+    from hpa2_tpu.utils.checkpoint import _msg_from_list, _msg_to_list
+
+    m = Message(MsgType.READ_REQUEST, sender=1, address=9, deliver_at=42)
+    row = _msg_to_list(m)
+    assert len(row) == 7 and row[-1] == 42
+    assert _msg_from_list(row) == m
+    legacy = _msg_from_list(row[:6])  # pre-topology 6-element row
+    assert legacy.deliver_at == 0
+    assert legacy.address == 9
+
+
+# -- (7) backends without the model refuse it -----------------------------
+
+
+def test_pallas_rejects_non_ideal():
+    from hpa2_tpu.ops.pallas_engine import PallasEngine
+
+    cfg = _mesh_cfg(procs=4)
+    with pytest.raises(ValueError, match="ideal topology only"):
+        PallasEngine(cfg, *gen_uniform_random_arrays(cfg, 2, 8, seed=0))
+
+
+def test_node_sharding_rejects_non_ideal():
+    from hpa2_tpu.parallel.sharding import GridEngine, NodeShardedEngine
+
+    cfg = _mesh_cfg(procs=4)
+    traces = gen_uniform_random(cfg, 8, seed=0)
+    with pytest.raises(ValueError, match="single-shard"):
+        NodeShardedEngine(cfg, traces)
+    with pytest.raises(ValueError, match="single-shard"):
+        GridEngine(cfg, [traces])
+
+
+def test_replay_rejects_non_ideal(reference_tests_dir):
+    from hpa2_tpu.utils.trace import load_instruction_order, load_trace_dir
+
+    cfg = SystemConfig(interconnect=InterconnectConfig(topology="mesh2d"))
+    suite = str(reference_tests_dir / "test_1")
+    traces = load_trace_dir(suite, cfg)
+    order = load_instruction_order(
+        os.path.join(suite, "instruction_order.txt")
+    )
+    with pytest.raises(ValueError, match="replay"):
+        JaxEngine(cfg, traces, replay_order=order)
+
+
+def test_cli_gates_non_ideal_backends(tmp_path, reference_tests_dir):
+    from hpa2_tpu.cli import main
+
+    suite = str(reference_tests_dir / "test_1")
+    with pytest.raises(SystemExit, match="spec and"):
+        main(["run", suite, "--backend", "pallas",
+              "--topology", "mesh2d", "--out", str(tmp_path)])
+    # the supported spelling runs end to end
+    rc = main(["run", suite, "--backend", "jax", "--topology", "mesh2d",
+               "--multicast", "--out", str(tmp_path)])
+    assert rc == 0
+    assert (tmp_path / "core_0_output.txt").exists()
+
+
+# -- (8) interconnect-purity lint rule ------------------------------------
+
+
+def test_lint_flags_rng_in_interconnect(tmp_path):
+    from hpa2_tpu.analysis.lint import lint_file
+
+    rel = os.path.join("hpa2_tpu", "interconnect", "bad.py")
+    os.makedirs(os.path.dirname(str(tmp_path / rel)))
+    (tmp_path / rel).write_text(
+        "import random\n"
+        "import numpy as np\n"
+        "def jitter():\n"
+        "    return random.random() + np.random.rand()\n"
+    )
+    findings = lint_file(str(tmp_path), rel)
+    assert findings
+    assert any("pure function of config + trace" in f.message
+               for f in findings)
+
+    (tmp_path / rel).write_text("import numpy as np\nX = np.zeros(3)\n")
+    assert lint_file(str(tmp_path), rel) == []
+
+
+def test_lint_repo_is_clean():
+    from hpa2_tpu.analysis.lint import run_lint
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert run_lint(repo_root) == []
