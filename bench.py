@@ -77,6 +77,7 @@ def _bench_config():
     return SystemConfig(
         num_procs=8, msg_buffer_size=_CAP,
         semantics=Semantics().robust(),
+        elide=not _no_elide(),
     )
 
 
@@ -105,6 +106,15 @@ def _packed() -> bool:
     """Packed-state-plane knob (``--packed``): run the Pallas engines
     with the uint8/uint16 split planes instead of int32 words."""
     return os.environ.get("HPA2_BENCH_PACKED", "") == "1"
+
+
+def _no_elide() -> bool:
+    """Cycle-elision A/B knob (``--no-elide``): rebuild the XLA run
+    programs as pure lockstep (``Config.elide=False``) so elided vs
+    lockstep wall-clock lands in artifact diffs.  The Pallas engines
+    run lockstep either way (their in-kernel quiescence gate already
+    skips drained blocks), so this only moves the XLA paths."""
+    return os.environ.get("HPA2_BENCH_NO_ELIDE", "") == "1"
 
 
 def _schedule_knobs():
@@ -312,7 +322,16 @@ def bench_jax(config, batch, instrs_per_core, seed=0):
         "measured over a partial workload"
     )
     instrs = int(jnp.sum(out.n_instr))
-    return instrs, dt
+    # elision counters (only-when-nonzero, like the stats schema):
+    # zero under --no-elide and whenever the workload never had a
+    # provably-quiet cycle to skip
+    counters = {}
+    for key, field in (("elided_cycles", out.n_elided),
+                       ("multi_hit_retired", out.n_multi_hit)):
+        val = int(jnp.sum(field))
+        if val:
+            counters[key] = val
+    return instrs, dt, counters
 
 
 def bench_omp(config, instrs_per_core, seed=0, mode="omp"):
@@ -345,6 +364,7 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
     occupancy = None
     exchange = None
     phases = None
+    elision = {}
     if pallas_ok or not on_tpu:  # CPU always tries interpret mode
         try:
             jax_instrs, jax_dt, occupancy, exchange, phases = bench_pallas(
@@ -360,7 +380,9 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
         engine = "xla"
         if on_tpu:
             batch = 1024
-        jax_instrs, jax_dt = bench_jax(config, batch, instrs_per_core)
+        jax_instrs, jax_dt, elision = bench_jax(
+            config, batch, instrs_per_core
+        )
     jax_ops = jax_instrs / jax_dt
 
     result = {
@@ -380,6 +402,10 @@ def child_main(platform: str, pallas_ok: bool, pallas_error: str) -> int:
     # kernel-layout / scheduler provenance: always recorded so artifact
     # diffs across rounds show WHICH path produced the number
     result["packed_planes"] = packed and engine == "pallas"
+    # event-driven elision provenance (+ counters when it fired; the
+    # lockstep Pallas engines always report none)
+    result["elide"] = config.elide
+    result.update(elision)
     result["fused_schedule"] = bool(
         resident and fused and engine == "pallas"
     )
@@ -995,6 +1021,11 @@ def main() -> int:
         # uint8/uint16 packed state planes (ISSUE 6): ~2x the lanes
         # per VMEM budget; bit-exact vs the int32 layout
         os.environ["HPA2_BENCH_PACKED"] = "1"
+    if "--no-elide" in sys.argv:
+        # lockstep A/B baseline for the event-driven cycle elision
+        # (ISSUE 12): bit-identical results, one device step per
+        # simulated cycle
+        os.environ["HPA2_BENCH_NO_ELIDE"] = "1"
     if "--schedule-resident" in sys.argv:
         # occupancy scheduler with this many device-resident lanes;
         # fused single-program by default, --host-barriers for the

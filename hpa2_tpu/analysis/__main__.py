@@ -8,6 +8,7 @@ Subcommands::
     python -m hpa2_tpu.analysis mutation-test  # analyzer self-test
     python -m hpa2_tpu.analysis vmem           # static VMEM budget model
     python -m hpa2_tpu.analysis occupancy      # occupancy scheduler model
+    python -m hpa2_tpu.analysis elision        # cycle-elision exact replay
     python -m hpa2_tpu.analysis topology       # interconnect sensitivity
 
 ``check`` is the cheap gate (pure Python, no JAX import): whole-table
@@ -154,6 +155,26 @@ def cmd_occupancy(args: argparse.Namespace) -> int:
     return rc
 
 
+def cmd_elision(args: argparse.Namespace) -> int:
+    from hpa2_tpu.analysis.elision import elision_table
+
+    table, rc = elision_table(
+        procs=args.procs,
+        instrs=args.instrs,
+        spreads=tuple(float(s) for s in args.spreads.split(",")),
+        tail=args.tail,
+        write_frac=args.write_frac,
+        seed=args.seed,
+        topology=args.topology,
+        verify=not args.no_verify,
+    )
+    print(table)
+    if rc:
+        print("MODEL VIOLATION: predicted elision counters diverge "
+              "from the device run")
+    return rc
+
+
 def cmd_topology(args: argparse.Namespace) -> int:
     from hpa2_tpu.analysis.topology import topology_table
 
@@ -240,6 +261,21 @@ def main(argv=None) -> int:
                     help="comma-separated admission policies to "
                          "compare (fcfs,longest-first) — one table "
                          "row per policy")
+    lp2 = sub.add_parser("elision", help="event-driven cycle-elision "
+                         "model (exact replay vs device counters)")
+    lp2.add_argument("--procs", type=int, default=4)
+    lp2.add_argument("--instrs", type=int, default=400,
+                     help="per-core trace length")
+    lp2.add_argument("--spreads", default="2,4,8",
+                     help="comma-separated Zipf hot-set spreads")
+    lp2.add_argument("--tail", type=float, default=0.01,
+                     help="uniform-random miss-traffic fraction")
+    lp2.add_argument("--write-frac", type=float, default=0.3)
+    lp2.add_argument("--seed", type=int, default=3)
+    lp2.add_argument("--topology", default="ideal",
+                     help="interconnect topology for the modeled run")
+    lp2.add_argument("--no-verify", action="store_true",
+                     help="model only; skip the device cross-check")
     tp = sub.add_parser("topology", help="interconnect sensitivity "
                         "(invalidation-storm cost per topology)")
     tp.add_argument("--nodes", type=int, default=8)
@@ -266,6 +302,7 @@ def main(argv=None) -> int:
         "mutation-test": cmd_mutation_test,
         "vmem": cmd_vmem,
         "occupancy": cmd_occupancy,
+        "elision": cmd_elision,
         "topology": cmd_topology,
     }[args.cmd](args)
 

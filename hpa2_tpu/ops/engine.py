@@ -34,6 +34,9 @@ from hpa2_tpu.ops.state import (
     init_state,
 )
 from hpa2_tpu.ops.step import (
+    build_elided_body,
+    build_fast_forward,
+    build_propose,
     build_run,
     build_step,
     build_step_jitted,
@@ -319,6 +322,11 @@ def engine_stats(st: SimState) -> dict:
         ("topo_delay_cycles", st.n_topo_delay),
         ("topo_multicast_saved", st.n_multicast_saved),
         ("topo_combined", st.n_combined),
+        # elision counters (ISSUE-12): zero (hence absent) under
+        # Config.elide=False and on lockstep backends, so the schema
+        # is unchanged wherever elision never fired
+        ("elided_cycles", st.n_elided),
+        ("multi_hit_retired", st.n_multi_hit),
     ):
         val = tot(field)
         if val:
@@ -383,9 +391,18 @@ def build_batched_run(config: SystemConfig, max_cycles: int = 1_000_000,
     ops/step.py's single-system watchdog), so a severed-link livelock
     surfaces as a :class:`StallDiagnostic` instead of burning to
     ``max_cycles``.
+
+    With ``config.elide`` the loop body is the event-driven one (one
+    shared jump per device step — the minimum over every lane's
+    proposal, so the batch-wide cycle counter stays exactly lockstep's;
+    see ops/step.py).
     """
-    step = build_step(config, replay=False)
-    vstep = jax.vmap(step)
+    if config.elide:
+        body = build_elided_body(
+            config, max_cycles, watchdog_cycles, batched=True
+        )
+    else:
+        body = jax.vmap(build_step(config, replay=False))
     vquiet = jax.vmap(quiescent)
 
     def cond(st):
@@ -401,19 +418,23 @@ def build_batched_run(config: SystemConfig, max_cycles: int = 1_000_000,
         return go
 
     def run(st: SimState) -> SimState:
-        return jax.lax.while_loop(cond, vstep, st)
+        return jax.lax.while_loop(cond, body, st)
 
     return jax.jit(run)
 
 
-@functools.lru_cache(maxsize=16)
-def build_batched_run_chunk(config: SystemConfig, chunk: int):
-    """Jitted bounded advance: up to ``chunk`` cycles (or quiescence),
-    then return to the host — the checkpointing granule.  Repeated
-    calls continue bit-identically, so `run_chunk^k` == one long run
-    (tests/test_checkpoint.py gates this)."""
-    step = build_step(config, replay=False)
-    vstep = jax.vmap(step)
+def _chunk_loop(config: SystemConfig, chunk: int):
+    """cond/body pair shared by the bounded-advance chunk programs.
+
+    The chunk budget ``c`` counts SIMULATED cycles, not device steps:
+    under elision each jump is capped at the chunk boundary and
+    advances ``c`` by its full width, so every interval barrier lands
+    on exactly the lockstep cycle (row ages, admission timing and
+    occupancy accounting stay byte-identical).  Host-side watchdogs
+    compare ``cycle - last_progress`` at the barrier, both in
+    simulated cycles, so a jump can never mask a stall.
+    """
+    vstep = jax.vmap(build_step(config, replay=False))
     vquiet = jax.vmap(quiescent)
 
     def cond(c_st):
@@ -424,9 +445,35 @@ def build_batched_run_chunk(config: SystemConfig, chunk: int):
             & ~jnp.any(st.overflow)
         )
 
-    def body(c_st):
-        c, st = c_st
-        return c + 1, vstep(st)
+    if config.elide:
+        # the chunk clamp bounds every jump, so propose needs no
+        # max_cycles/watchdog terms of its own (both are enforced by
+        # the host at barriers, in simulated cycles)
+        vprop = jax.vmap(build_propose(config, max_cycles=2**31 - 1))
+        vff = jax.vmap(build_fast_forward(config), in_axes=(0, None))
+
+        def body(c_st):
+            c, st = c_st
+            j = jnp.minimum(jnp.min(vprop(st)), chunk - c)
+            st = jax.lax.cond(j > 0, lambda s: vff(s, j), vstep, st)
+            return c + jnp.maximum(j, 1), st
+
+    else:
+
+        def body(c_st):
+            c, st = c_st
+            return c + 1, vstep(st)
+
+    return cond, body
+
+
+@functools.lru_cache(maxsize=16)
+def build_batched_run_chunk(config: SystemConfig, chunk: int):
+    """Jitted bounded advance: up to ``chunk`` cycles (or quiescence),
+    then return to the host — the checkpointing granule.  Repeated
+    calls continue bit-identically, so `run_chunk^k` == one long run
+    (tests/test_checkpoint.py gates this)."""
+    cond, body = _chunk_loop(config, chunk)
 
     def run(st: SimState) -> SimState:
         return jax.lax.while_loop(
@@ -668,7 +715,7 @@ class BatchJaxEngine:
             np.ones(b, dtype=np.int64), resident=r, block=1,
             groups=groups, threshold=self.schedule.threshold,
             fused=True,
-        )
+        ).attach_elision(st)
         return self
 
     def _run_scheduled(self) -> "BatchJaxEngine":
@@ -770,7 +817,9 @@ class BatchJaxEngine:
         # invert the row->system assignment history: full-ensemble
         # state in system order, so all readback works unchanged
         self.state = place(stack_states(store))
-        self.occupancy = stats.set_mode(fused=False)
+        self.occupancy = stats.set_mode(fused=False).attach_elision(
+            self.state
+        )
         return self
 
     def _batch_stall(self, vq: np.ndarray) -> Exception:
@@ -845,21 +894,7 @@ def _build_session_chunk(config: SystemConfig, chunk: int):
     """The bounded-advance chunk program of the scheduled path, jitted
     with the carried rows donated (device backends), so a serving
     session reuses its resident HBM planes across every chunk."""
-    step = build_step(config, replay=False)
-    vstep = jax.vmap(step)
-    vquiet = jax.vmap(quiescent)
-
-    def cond(c_st):
-        c, st = c_st
-        return (
-            (c < chunk)
-            & jnp.any(~vquiet(st))
-            & ~jnp.any(st.overflow)
-        )
-
-    def body(c_st):
-        c, st = c_st
-        return c + 1, vstep(st)
+    cond, body = _chunk_loop(config, chunk)
 
     def run(st: SimState) -> SimState:
         return jax.lax.while_loop(

@@ -60,6 +60,19 @@ tpu_differential.py gates the Mosaic path on hardware.  A
 a legal schedule of the same program, differential-tested against the
 spec engine run on the same segment schedule.
 
+Event-driven cycle elision (``Config.elide``, ISSUE-12) is an XLA-path
+knob: the Pallas family accepts the config but keeps running pure
+lockstep, so its ``elided_cycles`` / ``multi_hit_retired`` counters
+stay zero (hence absent from the stats schema — only-when-nonzero).
+That is deliberate, not a gap: the in-kernel quiescence gate already
+skips fully-drained blocks at ``_GATE`` granularity for ~free, which
+on this engine's throughput-ensemble workloads captures most of what
+per-cycle elision buys, and a data-dependent jump width would break
+the streamed path's window-prefetch contract (the double-buffered
+trace DMA schedule is precomputed from the lockstep cycle count; a
+mid-window fast-forward would have to re-aim in-flight copies).
+Event-driven Pallas blocks stay an open item (ROADMAP).
+
 Mosaic constraints honored throughout: no bool tensor is ever stored,
 selected against a scalar bool constant, or reduced (`arith.trunci
 i8->i1`, the BENCH_r03 compile failure) — masks live as i32 0/1 and

@@ -122,6 +122,13 @@ class OccupancyStats:
     #: systems sat queued between enqueue and their backfill barrier
     wait_intervals_total: int = 0
     wait_intervals_max: int = 0
+    #: event-driven elision counters (ISSUE-12), attached from the
+    #: device state after a scheduled run — zero (hence absent from
+    #: ``as_dict``) under ``Config.elide=False``, on lockstep
+    #: backends, and in the static replay model, so the artifact
+    #: schema is unchanged wherever elision never fired
+    elided_cycles: int = 0
+    multi_hit_retired: int = 0
 
     @property
     def mean_live_fraction(self) -> float:
@@ -149,7 +156,7 @@ class OccupancyStats:
         return self.wait_intervals_total / self.admissions
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "intervals": self.intervals,
             "block_segments": self.block_segments,
             "lockstep_block_segments": self.lockstep_block_segments,
@@ -164,6 +171,19 @@ class OccupancyStats:
             "wait_intervals_mean": round(self.wait_intervals_mean, 3),
             "wait_intervals_max": self.wait_intervals_max,
         }
+        if self.elided_cycles:
+            out["elided_cycles"] = self.elided_cycles
+        if self.multi_hit_retired:
+            out["multi_hit_retired"] = self.multi_hit_retired
+        return out
+
+    def attach_elision(self, state) -> "OccupancyStats":
+        """Fold the device elision counters from a finished run's
+        state into the scheduler stats (lane-summed, matching
+        ``engine_stats``)."""
+        self.elided_cycles = int(np.sum(np.asarray(state.n_elided)))
+        self.multi_hit_retired = int(np.sum(np.asarray(state.n_multi_hit)))
+        return self
 
     def set_mode(self, fused: bool) -> "OccupancyStats":
         """Fill the execution-shape counters for a run mode: the fused

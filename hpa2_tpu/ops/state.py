@@ -156,6 +156,11 @@ class SimState(NamedTuple):
     n_topo_delay: jnp.ndarray      # extra delay cycles beyond ideal
     n_multicast_saved: jnp.ndarray # link traversals saved by multicast
     n_combined: jnp.ndarray        # READ_REQUESTs merged in-network
+    # event-driven elision counters (ISSUE-12; scalars).  device_steps
+    # executed == cycle - n_elided; both stay zero under Config.elide
+    # =False and on engines that run lockstep (spec, pallas).
+    n_elided: jnp.ndarray     # simulated cycles skipped by fast-forward
+    n_multi_hit: jnp.ndarray  # instructions retired inside fast-forwards
 
 
 def init_state_batched(
@@ -251,6 +256,8 @@ def init_state_batched(
         n_topo_delay=zeros((b,), I32),
         n_multicast_saved=zeros((b,), I32),
         n_combined=zeros((b,), I32),
+        n_elided=zeros((b,), I32),
+        n_multi_hit=zeros((b,), I32),
     )
 
 
@@ -357,4 +364,6 @@ def init_state(
         n_topo_delay=jnp.zeros((), dtype=I32),
         n_multicast_saved=jnp.zeros((), dtype=I32),
         n_combined=jnp.zeros((), dtype=I32),
+        n_elided=jnp.zeros((), dtype=I32),
+        n_multi_hit=jnp.zeros((), dtype=I32),
     )

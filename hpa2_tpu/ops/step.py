@@ -1280,6 +1280,8 @@ def build_step(
             n_topo_delay=st.n_topo_delay + topo_delay_inc,
             n_multicast_saved=st.n_multicast_saved + mc_saved_inc,
             n_combined=st.n_combined + comb_inc,
+            n_elided=st.n_elided,
+            n_multi_hit=st.n_multi_hit,
         )
 
     return step
@@ -1299,6 +1301,298 @@ def quiescent(st: SimState) -> jnp.ndarray:
     return done & replay_done
 
 
+# ===================== event-driven cycle elision =====================
+#
+# The lockstep loop pays one full device step per simulated cycle even
+# when the cycle is provably quiet.  Elision (ISSUE-12) makes the loop
+# event-driven, bit-exactly: a cheap on-device reduction (``propose``)
+# computes how many upcoming cycles are *certain* to be uneventful —
+# no deliverable message, no blocked sender retry, every ready issuer
+# sitting on a run of silent cache hits — and a single fast-forward
+# step (``fast_forward``) advances the state across all of them at
+# once.  Two event classes are collapsed:
+#
+# * **idle cycles**: nothing in flight (or, under a non-ideal
+#   topology, every mailbox head still in transit) — time jumps to the
+#   earliest ``deliver_at`` / watchdog / max_cycles boundary;
+# * **multi-hit runs**: a node whose next k trace entries are all
+#   silent cache hits (read hit on M/E/S, write hit on M/E — no
+#   message, no directory or remote-visible transition) retires all k
+#   in one step.  Write hits collapse by last-write-wins per cache
+#   slot, exactly the serial lockstep result.
+#
+# A cycle is elidable only when *no* node can act differently from
+# "retire a silent hit or idle": any blocked sender (outbox retries
+# consume fault-layer randomness and can succeed), any deliverable
+# mailbox head, or any ready issuer whose next entry is not a silent
+# hit forces a normal lockstep step.  Under fault injection the
+# carried PRNG key is split once per simulated cycle by the lockstep
+# step, so the fast-forward replays exactly j splits to keep the fault
+# stream aligned.  Bit-exactness (dumps, cycle counts, every stat) is
+# the contract; ``Config.elide=False`` rebuilds the pure lockstep
+# loop.  Device steps executed == ``cycle - n_elided``.
+
+# static trace-window bound for the multi-hit scan: a run longer than
+# this retires in ceil(h / window) fast-forward steps (still far ahead
+# of lockstep's h steps)
+_ELISION_WINDOW = 64
+# "no event" distance marker; every real candidate is far smaller
+_FAR = np.iinfo(np.int32).max
+
+
+def _fetch_window(arr, idx):
+    """arr [N, T], idx [N, L] -> [N, L]; one-hot below _ONEHOT_MAX_K
+    (same TPU scalarized-gather avoidance as ``_fetch_n``)."""
+    t = arr.shape[1]
+    if t <= _ONEHOT_MAX_K:
+        hot = jnp.arange(t, dtype=I32)[None, None, :] == idx[:, :, None]
+        return jnp.sum(
+            jnp.where(hot, arr[:, None, :], arr.dtype.type(0)), axis=2
+        )
+    return jnp.take_along_axis(arr, idx, axis=1)
+
+
+def _issuers(st: SimState, blocked):
+    """Nodes that would issue an instruction this cycle (phase-B
+    eligibility for a cycle in which no message is handled)."""
+    return (
+        (st.mb_count == 0) & ~st.waiting & ~blocked & (st.pc < st.tr_len)
+    )
+
+
+def _hit_window(config: SystemConfig, st: SimState):
+    """Per-node silent-hit scan over the next ``_ELISION_WINDOW`` trace
+    entries -> (op, ia, iv, run_len) with run_len the prefix length of
+    entries that retire without any remote-visible transition.
+
+    The predicate is evaluated against the *current* cache planes,
+    which is exact for the whole prefix: silent hits never change a
+    tag, and the only state transition they make (E -> M on a write
+    hit) changes neither the read predicate (state != I) nor the write
+    predicate (state in {M, E}) of any later entry.
+    """
+    c = config.cache_size
+    t = st.tr_op.shape[1]
+    lw = min(_ELISION_WINDOW, t)
+    karr = jnp.arange(lw, dtype=I32)
+    pos = st.pc[:, None] + karr[None, :]
+    idx = jnp.minimum(pos, t - 1)
+    op = _fetch_window(st.tr_op, idx)
+    ia = _fetch_window(st.tr_addr, idx)
+    iv = _fetch_window(st.tr_val, idx)
+    ci = ia % c
+    tag = _fetch_window(st.cache_addr, ci)
+    stt = _fetch_window(st.cache_state, ci)
+    is_w = op == 1
+    silent = (
+        (pos < st.tr_len[:, None])
+        & (tag == ia)
+        & jnp.where(is_w, (stt == _M) | (stt == _E), stt != _I)
+    )
+    run_len = jnp.sum(jnp.cumprod(silent.astype(I32), axis=1), axis=1)
+    return op, ia, iv, run_len
+
+
+def build_propose(config: SystemConfig, max_cycles: int = 1_000_000,
+                  watchdog_cycles: int = 0):
+    """Build ``propose(st) -> [3N + 2] int32`` candidate distances.
+
+    ``min(propose(st))`` is the number of cycles that can be
+    fast-forwarded in one device step: 0 means "this cycle may be
+    eventful — run the lockstep step"; j >= 1 means cycles
+    ``cycle .. cycle + j - 1`` are all provably silent.  Returning the
+    un-reduced candidate vector lets every runner fold its own lane /
+    shard axes into ONE ``reduce_min`` (the jaxpr guard in
+    tests/test_elision.py pins exactly one added reduction).
+
+    Candidate classes (``_FAR`` = no constraint from that source):
+    per-node must-step (0 when blocked or a head is deliverable now),
+    per-node topology gate (head ``deliver_at - cycle``), per-node
+    issuer hit-run length (0 when the next entry is not a silent hit),
+    plus two scalars: the watchdog boundary (idle time may not jump
+    past ``last_progress + watchdog_cycles`` — simulated-cycle stall
+    accounting survives elision) and the ``max_cycles`` boundary.
+    """
+    n = config.num_procs
+    w = config.sharer_words
+    topo_on = config.interconnect.enabled
+    mb_deliver = 5 + w  # deliver-at column (topology builds only)
+
+    def propose(st: SimState) -> jnp.ndarray:
+        far = jnp.full((n,), _FAR, dtype=I32)
+        blocked = jnp.any(st.ob_valid, axis=1)
+        has_mail = st.mb_count > 0
+        if topo_on:
+            head_at = st.mb_data[:, 0, mb_deliver]
+            ready_now = has_mail & (head_at <= st.cycle)
+            gate = jnp.where(has_mail & ~ready_now, head_at - st.cycle,
+                             far)
+        else:
+            ready_now = has_mail
+            gate = far
+        issuer = _issuers(st, blocked)
+        _, _, _, run_len = _hit_window(config, st)
+        must = jnp.where(blocked | ready_now, 0, far)
+        hits = jnp.where(issuer, run_len, far)
+        if watchdog_cycles:
+            gap = st.last_progress + watchdog_cycles - st.cycle
+            # issuers advance last_progress every elided cycle, and a
+            # lane already past its boundary (possible mid-batch when a
+            # sibling lane holds the loop open) idles unchanged either
+            # way — both propose no constraint
+            wd = jnp.where(jnp.any(issuer) | (gap < 1), _FAR, gap)
+        else:
+            wd = jnp.asarray(_FAR, dtype=I32)
+        cap = jnp.asarray(max_cycles, dtype=I32) - st.cycle
+        return jnp.concatenate(
+            [must, gate, hits, jnp.stack([wd, cap])]
+        )
+
+    return propose
+
+
+def build_fast_forward(config: SystemConfig):
+    """Build ``fast_forward(st, j) -> SimState``: advance j >= 1
+    provably-silent cycles (j <= min(propose(st))) in one device step.
+
+    Issuers retire exactly j silent hits each (j never exceeds any
+    issuer's run length, so trace completion can only land on the jump
+    end); everyone else idles.  No message moves, so mailboxes,
+    outboxes, directories and memory are untouched; write hits apply
+    last-write-wins per cache slot and the final ``pending_write``
+    mirrors lockstep's per-write overwrite.  Under fault injection the
+    PRNG key replays the j per-cycle splits the lockstep step would
+    have drawn (their samples are never observed in a silent cycle —
+    no candidate crosses the wire).
+    """
+    fault_on = config.fault.enabled
+    c = config.cache_size
+
+    def fast_forward(st: SimState, j: jnp.ndarray) -> SimState:
+        blocked = jnp.any(st.ob_valid, axis=1)  # all-false given j >= 1
+        issuer = _issuers(st, blocked)
+        op, ia, iv, _ = _hit_window(config, st)
+        lw = op.shape[1]
+        karr = jnp.arange(lw, dtype=I32)
+        in_run = issuer[:, None] & (karr[None, :] < j)
+        is_w = in_run & (op == 1)
+        # last write per cache slot wins — the serial per-cycle write
+        # hits collapsed into one scatter ([N, L, C] one-hot; lastk is
+        # 1-based so 0 = "slot untouched")
+        slot_hot = (
+            (ia % c)[:, :, None] == jnp.arange(c, dtype=I32)[None, None, :]
+        )
+        wslot = is_w[:, :, None] & slot_hot
+        lastk = jnp.max(
+            jnp.where(wslot, karr[None, :, None] + 1, 0), axis=1
+        )
+        wrote = lastk > 0
+        wval = jnp.sum(
+            jnp.where(
+                (karr[None, :, None] + 1) == lastk[:, None, :],
+                iv[:, :, None], 0,
+            ),
+            axis=1,
+        )
+        cache_val = jnp.where(wrote, wval, st.cache_val)
+        cache_state = jnp.where(wrote, _M, st.cache_state)
+        # lockstep overwrites pending_write on EVERY write issue (hits
+        # included): the jump leaves the last written value behind
+        lastw = jnp.max(jnp.where(is_w, karr[None, :] + 1, 0), axis=1)
+        pwval = jnp.sum(
+            jnp.where((karr[None, :] + 1) == lastw[:, None], iv, 0),
+            axis=1,
+        )
+        pending_write = jnp.where(lastw > 0, pwval, st.pending_write)
+
+        retired = jnp.sum(in_run.astype(I32))
+        rd_inc = jnp.sum((in_run & (op == 0)).astype(I32))
+        wr_inc = jnp.sum(is_w.astype(I32))
+        pc = st.pc + jnp.where(issuer, j, 0)
+        cycle = st.cycle + j
+        # every elided cycle with issuers retires instructions, so the
+        # watchdog sees the same progress trail as lockstep
+        last_progress = jnp.where(
+            jnp.any(issuer), cycle - 1, st.last_progress
+        )
+        if fault_on:
+            # lockstep splits the carried key once per cycle whether or
+            # not anything crosses the wire; replay exactly j splits
+            rng_key = jax.lax.fori_loop(
+                0, j, lambda _, k: jax.random.split(k, 5)[4], st.rng_key
+            )
+        else:
+            rng_key = st.rng_key
+        # phase D at the jump end: completion only lands there (mid-run
+        # pc + t < tr_len), and an already-done node's planes are
+        # untouched by other nodes' silent hits, so the snapshot equals
+        # the one lockstep would have taken at its completion cycle
+        done_node = (
+            (pc >= st.tr_len) & ~st.waiting & (st.mb_count == 0) & ~blocked
+        )
+        snap_now = done_node & ~st.snap_taken
+        s2 = snap_now[:, None]
+        s3 = snap_now[:, None, None]
+        return st._replace(
+            cache_val=cache_val,
+            cache_state=cache_state,
+            pending_write=pending_write,
+            pc=pc,
+            snap_taken=st.snap_taken | done_node,
+            snap_mem=jnp.where(s2, st.mem, st.snap_mem),
+            snap_dir_state=jnp.where(s2, st.dir_state, st.snap_dir_state),
+            snap_dir_sharers=jnp.where(
+                s3, st.dir_sharers, st.snap_dir_sharers
+            ),
+            snap_cache_addr=jnp.where(
+                s2, st.cache_addr, st.snap_cache_addr
+            ),
+            snap_cache_val=jnp.where(s2, cache_val, st.snap_cache_val),
+            snap_cache_state=jnp.where(
+                s2, cache_state, st.snap_cache_state
+            ),
+            cycle=cycle,
+            n_instr=st.n_instr + retired,
+            n_read_hits=st.n_read_hits + rd_inc,
+            n_write_hits=st.n_write_hits + wr_inc,
+            rng_key=rng_key,
+            last_progress=last_progress,
+            n_elided=st.n_elided + j - 1,
+            n_multi_hit=st.n_multi_hit + retired,
+        )
+
+    return fast_forward
+
+
+def build_elided_body(config: SystemConfig, max_cycles: int = 1_000_000,
+                      watchdog_cycles: int = 0, batched: bool = False):
+    """The event-driven while-loop body: one reduction picks the jump
+    distance, one ``lax.cond`` selects fast-forward vs lockstep.
+
+    Batched: the jump is the minimum over every lane's candidates —
+    lanes share one cycle counter in batched runs, so a single shared
+    jump keeps all per-lane schedules exactly lockstep's.
+    """
+    step = build_step(config)
+    propose = build_propose(config, max_cycles, watchdog_cycles)
+    ff = build_fast_forward(config)
+    if batched:
+        vstep = jax.vmap(step)
+        vff = jax.vmap(ff, in_axes=(0, None))
+
+        def body(st: SimState) -> SimState:
+            j = jnp.min(jax.vmap(propose)(st))
+            return jax.lax.cond(j > 0, lambda s: vff(s, j), vstep, st)
+
+    else:
+
+        def body(st: SimState) -> SimState:
+            j = jnp.min(propose(st))
+            return jax.lax.cond(j > 0, lambda s: ff(s, j), step, st)
+
+    return body
+
+
 @functools.lru_cache(maxsize=64)
 def build_run(config: SystemConfig, replay: bool = False,
               max_cycles: int = 1_000_000, watchdog_cycles: int = 0):
@@ -1313,8 +1607,16 @@ def build_run(config: SystemConfig, replay: bool = False,
     and no mailbox has drained for that many consecutive cycles —
     the only on-device early-exit for livelocks, which otherwise
     burn the full ``max_cycles`` budget before the host notices.
+
+    With ``config.elide`` (and outside replay mode, which pins a
+    per-cycle issue schedule) the loop body is the event-driven one —
+    bit-identical results in fewer device steps (``st.n_elided``
+    counts the skipped cycles).
     """
-    step = build_step(config, replay=replay)
+    if config.elide and not replay:
+        step = build_elided_body(config, max_cycles, watchdog_cycles)
+    else:
+        step = build_step(config, replay=replay)
 
     def cond(st):
         live = (~quiescent(st)) & (st.cycle < max_cycles) & (~st.overflow)

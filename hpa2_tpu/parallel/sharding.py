@@ -44,7 +44,8 @@ from hpa2_tpu.ops.engine import (
 from hpa2_tpu.ops.pallas_engine import (
     PallasEngine, PallasLaneSession, choose_block)
 from hpa2_tpu.ops.state import SimState, init_state
-from hpa2_tpu.ops.step import build_step, quiescent
+from hpa2_tpu.ops.step import (
+    build_fast_forward, build_propose, build_step, quiescent)
 from hpa2_tpu.utils.dump import NodeDump
 
 # SimState fields whose leading (non-batch) axis is the node axis;
@@ -63,7 +64,8 @@ _NODE_LEADING = frozenset(
                  # interconnect fields lead with the link axis (or are
                  # scalar counters), never the node axis
                  "link_traversals", "link_max_load", "n_topo_delay",
-                 "n_multicast_saved", "n_combined")
+                 "n_multicast_saved", "n_combined",
+                 "n_elided", "n_multi_hit")
 )
 
 
@@ -147,6 +149,16 @@ def build_node_sharded_run(
     still-live system has made progress for that many cycles, so the
     host can raise a :class:`StallDiagnostic` instead of burning to
     ``max_cycles``.
+
+    Cycle elision (ISSUE-12) composes with DATA sharding: each shard
+    reduces its own lanes' proposals and one ``lax.pmin`` over the
+    ``data`` axis makes the jump the global batch minimum — exactly the
+    unsharded batched jump, so per-lane cycle counters stay
+    bit-identical to the single-device run.  With the NODE axis
+    actually sharded (node_shards > 1) elision is not implemented and
+    the loop silently stays lockstep — still bit-exact, just without
+    the device-step savings (a per-node-shard propose would also need
+    its events folded across the exchange rounds; deferred).
     """
     node_shards = mesh.shape["node"]
     step = build_step(
@@ -156,6 +168,28 @@ def build_node_sharded_run(
     body = step
     if batched:
         body = jax.vmap(step)
+    if config.elide and node_shards == 1:
+        propose = build_propose(config, max_cycles, watchdog_cycles)
+        ff = build_fast_forward(config)
+        lockstep = body
+        if batched:
+            vff = jax.vmap(ff, in_axes=(0, None))
+            vprop = jax.vmap(propose)
+
+            def body(st):
+                j = jax.lax.pmin(jnp.min(vprop(st)), "data")
+                return jax.lax.cond(
+                    j > 0, lambda s: vff(s, j), lockstep, st
+                )
+
+        else:
+
+            def body(st):
+                j = jax.lax.pmin(jnp.min(propose(st)), "data")
+                return jax.lax.cond(
+                    j > 0, lambda s: ff(s, j), lockstep, st
+                )
+
     wrapped = hostenv.shard_map(
         body,
         mesh=mesh,

@@ -52,6 +52,11 @@ Steps, in value order:
                      p50/p99 job latency under Poisson and heavy-tail
                      arrivals, with the pipelined-vs-serial staging
                      overlap split
+  elision512         ISSUE-12 event-driven cycle elision at the
+                     shipped batch shape (32768 lanes, zipf 8x
+                     private hot sets) on the batched XLA engine:
+                     elide on/off wall-clock, elided-cycle /
+                     multi-hit counters, full-state bit-identity gate
   topo512            interconnect sensitivity study at a 16-node x
                      24-round invalidation storm (bench.py --topology
                      with HPA2_TOPO_NODES/ROUNDS): rewrites
@@ -299,6 +304,87 @@ def measure_fused_occupancy_child(params) -> int:
     return 0 if exact5 and exactf else 1
 
 
+def measure_elision_child(params) -> int:
+    """--measure-elision mode: zipf private-hot-set ensemble on the
+    batched XLA engine (elision is an XLA-path knob; Pallas runs
+    lockstep either way), elide=True vs elide=False wall-clock plus
+    the device counters, one JSON line out.  Nonzero exit iff any
+    state plane other than the two elision counters differs between
+    the runs — the bit-identity contract, measured at scale.
+    Params: batch instrs spread tail_bp (spread = hot-set max/min
+    weight; tail_bp = uniform-tail fraction in basis points).  The
+    batched jump is the MIN over every lane (the vmapped while loop
+    is one joint program), so one lane's tail miss forces the whole
+    ensemble to lockstep: at 32768 lanes any nonzero tail measures
+    ~zero elision by construction.  The scale step therefore runs the
+    pure hot-set variant (tail_bp=0); the tail-bearing single-system
+    numbers live in PERF.md and tests/test_elision.py."""
+    import dataclasses
+
+    import numpy as np
+
+    from hpa2_tpu.config import Semantics, SystemConfig
+
+    batch, instrs = params[0], params[1]
+    spread = float(params[2]) if len(params) > 2 else 8.0
+    tail = (params[3] if len(params) > 3 else 100) / 10_000.0
+    config = SystemConfig(num_procs=8, semantics=Semantics().robust())
+
+    # vectorized gen_hot_hit_zipf: the nested-Instr generator builds
+    # Python objects per instruction — fine for tests, not for a
+    # 32768-lane ensemble.  Same distribution: per-node slot-distinct
+    # hot set with zipf-like weights, tail-fraction uniform addresses.
+    rng = np.random.default_rng(0)
+    n, t = config.num_procs, instrs
+    h = min(config.cache_size, config.mem_size)
+    w = np.arange(1, h + 1, dtype=np.float64) ** -(
+        np.log(spread) / np.log(float(h)) if h > 1 else 0.0)
+    hot = (np.arange(n) * config.mem_size)[None, :, None] + rng.choice(
+        h, size=(batch, n, t), p=w / w.sum())
+    tr_addr = np.where(
+        rng.random((batch, n, t)) < tail,
+        rng.integers(0, config.num_addresses, (batch, n, t)),
+        hot).astype(np.int32)
+    tr_op = (rng.random((batch, n, t)) < 0.3).astype(np.int32)
+    tr_val = rng.integers(0, 256, (batch, n, t)).astype(np.int32)
+    tr_len = np.full((batch, n), t, dtype=np.int32)
+
+    import jax
+
+    from hpa2_tpu.ops.engine import build_batched_run
+    from hpa2_tpu.ops.state import init_state_batched
+
+    def timed(cfg):
+        run = jax.jit(build_batched_run(cfg, max_cycles=1_000_000))
+        st = init_state_batched(cfg, tr_op, tr_addr, tr_val, tr_len)
+        jax.block_until_ready(run(st))  # compile + warmup
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(run(st))
+        return out, time.perf_counter() - t0
+
+    on, on_dt = timed(config)
+    off, off_dt = timed(dataclasses.replace(config, elide=False))
+    exact = all(
+        bool(np.array_equal(np.asarray(getattr(on, f)),
+                            np.asarray(getattr(off, f))))
+        for f in on._fields if f not in ("n_elided", "n_multi_hit"))
+    cycles = int(np.sum(np.asarray(on.cycle)))
+    elided = int(np.sum(np.asarray(on.n_elided)))
+    print(json.dumps({
+        "batch": batch, "instrs": instrs, "spread": spread,
+        "tail": tail,
+        "elide_s": round(on_dt, 3), "no_elide_s": round(off_dt, 3),
+        "wall_speedup": round(off_dt / on_dt, 2) if on_dt else None,
+        "simulated_cycles": cycles, "elided_cycles": elided,
+        "multi_hit_retired": int(np.sum(np.asarray(on.n_multi_hit))),
+        "step_reduction":
+            round(cycles / (cycles - elided), 2) if cycles > elided
+            else None,
+        "bit_exact": exact,
+    }))
+    return 0 if exact else 1
+
+
 def measure_nodeshard_child(params) -> int:
     """--measure-nodeshard mode: one system's node planes split over
     ``shards`` devices (NodeShardedPallasEngine, targeted ppermute
@@ -457,6 +543,10 @@ def main() -> int:
         return measure_fused_occupancy_child(
             [int(x) for x in sys.argv[2:11]]
         )
+    if sys.argv[1:2] == ["--measure-elision"]:
+        return measure_elision_child(
+            [int(x) for x in sys.argv[2:6]]
+        )
     if sys.argv[1:2] == ["--measure-nodeshard"]:
         return measure_nodeshard_child(
             [int(x) for x in sys.argv[2:12]]
@@ -603,6 +693,22 @@ def main() -> int:
                 timeout_s=3600, argv=True))
         finally:
             os.environ.pop("HPA2_SERVE_RESIDENT", None)
+
+    if "elision512" not in skip and gate("elision512"):
+        # ISSUE-12: event-driven cycle elision at the shipped batch
+        # shape on the XLA engine (the path the knob lives on) over
+        # the zipf private-hot-set workload — elide on vs off
+        # wall-clock, the device counters behind the ≥2x step
+        # reduction, and the full-state bit-identity gate.  tail_bp=0:
+        # the batched jump is a min over all 32768 lanes, so any
+        # uniform tail would collapse joint silence to zero (see the
+        # child docstring); the pure hot-set run is the shape elision
+        # is built for
+        note(run_py(
+            "elision512",
+            [os.path.abspath(__file__), "--measure-elision",
+             "32768", "128", "8", "0"],
+            timeout_s=1800, argv=True))
 
     if "topo512" not in skip and gate("topo512"):
         # ISSUE-11: the interconnect sensitivity study at a larger

@@ -151,6 +151,41 @@ then
   echo "TIER1: serving smoke failed" >&2
   exit 1
 fi
+# Elision smoke (~30s, CPU): the ISSUE-12 event-driven loop — a
+# scheduled zipf hot-set run must actually elide cycles, stay
+# byte-identical to the elide=False lockstep run, and the exact-replay
+# model (`analysis elision`) must reproduce the device counters
+# bit-for-bit.  Catches propose/fast-forward wiring breaks cheaply.
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python - > /dev/null <<'EOF'
+import dataclasses
+from hpa2_tpu.analysis.elision import elision_table
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.ops.engine import BatchJaxEngine
+from hpa2_tpu.ops.schedule import Schedule
+from hpa2_tpu.utils.trace import gen_hot_hit_zipf
+
+cfg = SystemConfig(num_procs=4, semantics=Semantics().robust())
+batch = [gen_hot_hit_zipf(cfg, 48, seed=20 + s) for s in range(4)]
+kw = dict(schedule=Schedule(interval=16, fused=False))
+eng = BatchJaxEngine(cfg, batch, **kw).run()
+ref = BatchJaxEngine(dataclasses.replace(cfg, elide=False), batch,
+                     **kw).run()
+occ = eng.occupancy.as_dict()
+assert occ["elided_cycles"] > 0, occ
+assert "elided_cycles" not in ref.occupancy.as_dict()
+for s in range(4):
+    assert eng.system_final_dumps(s) == ref.system_final_dumps(s), s
+    assert eng.system_snapshots(s) == ref.system_snapshots(s), s
+
+# model == device, asserted inside the table builder (rc != 0 on any
+# mismatch)
+table, rc = elision_table(procs=4, instrs=64, spreads=(8.0,))
+assert rc == 0, table
+EOF
+then
+  echo "TIER1: elision smoke failed" >&2
+  exit 1
+fi
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log

@@ -1,0 +1,352 @@
+"""Event-driven cycle elision (ISSUE-12).
+
+Three layers of protection:
+
+* a **jaxpr guard** pinning the elided hot loop's added structure to
+  exactly one reduction (the jump-distance ``reduce_min``) and one
+  ``cond`` (fast-forward vs lockstep select) — the lockstep phase
+  machinery moves inside the cond branch, and nothing else (no new
+  while/scan/dot_general) may appear at the loop's top level;
+* **bit-exactness sweeps**: dumps, final cycle counts, and all
+  non-elision stats must be byte-identical between ``elide=True`` and
+  the ``elide=False`` escape hatch across schedules, sharding, fault
+  injection, and topology — and the lockstep Pallas path (packed
+  planes included) must keep matching while reporting zero elision;
+* the **exact-replay model** (analysis/elision.py): predicted
+  ``elided_cycles`` / ``multi_hit_retired`` equal the device counters
+  bit-for-bit, including per-interval totals under the chunked
+  scheduled loop.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from hpa2_tpu.analysis.elision import (
+    predicted_batch_elision,
+    predicted_elision,
+)
+from hpa2_tpu.config import (
+    FaultModel,
+    InterconnectConfig,
+    Semantics,
+    SystemConfig,
+)
+from hpa2_tpu.models.spec_engine import StallDiagnostic
+from hpa2_tpu.ops.engine import BatchJaxEngine, JaxEngine
+from hpa2_tpu.ops.schedule import Schedule
+from hpa2_tpu.ops.state import init_state
+from hpa2_tpu.ops.step import build_run
+from hpa2_tpu.utils.trace import gen_hot_hit_zipf, gen_uniform_random
+
+ROBUST = Semantics().robust()
+_ELISION_KEYS = ("elided_cycles", "multi_hit_retired")
+
+
+def _cfg(**kw):
+    return SystemConfig(num_procs=4, semantics=ROBUST, **kw)
+
+
+def _strip(stats: dict) -> dict:
+    return {k: v for k, v in stats.items() if k not in _ELISION_KEYS}
+
+
+def _run_pair(cfg, traces, **kw):
+    on = JaxEngine(cfg, traces, **kw).run()
+    off = JaxEngine(
+        dataclasses.replace(cfg, elide=False), traces, **kw
+    ).run()
+    return on, off
+
+
+def _assert_single_exact(on: JaxEngine, off: JaxEngine):
+    assert int(on.state.cycle) == int(off.state.cycle)
+    assert on.final_dumps() == off.final_dumps()
+    assert on.snapshots() == off.snapshots()
+    assert _strip(on.stats()) == _strip(off.stats())
+    assert not any(k in off.stats() for k in _ELISION_KEYS)
+
+
+# -- jaxpr guard ------------------------------------------------------
+
+
+def _subvalues(eqn):
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (list, tuple)) else (v,)
+        for x in vs:
+            if hasattr(x, "jaxpr"):
+                yield x.jaxpr
+            elif hasattr(x, "eqns"):
+                yield x
+
+
+def _find_subjaxprs(jaxpr, prim_name):
+    found = []
+    for eqn in jaxpr.eqns:
+        subs = list(_subvalues(eqn))
+        if eqn.primitive.name == prim_name:
+            found += subs
+        else:
+            for sub in subs:
+                found += _find_subjaxprs(sub, prim_name)
+    return found
+
+
+def _top_counts(jaxpr, names):
+    return {
+        n: sum(1 for e in jaxpr.eqns if e.primitive.name == n)
+        for n in names
+    }
+
+
+def _outer_while_body(cfg):
+    traces = gen_hot_hit_zipf(cfg, 8, seed=0)
+    jx = jax.make_jaxpr(build_run(cfg))(init_state(cfg, traces))
+    subs = _find_subjaxprs(jx.jaxpr, "while")
+    assert subs, "run program lost its while_loop"
+    # the while carries [cond, body] subjaxprs; the body is the big one
+    return max(subs, key=lambda j: len(j.eqns))
+
+
+def test_elided_loop_jaxpr_guard():
+    """The event-driven loop body adds ONE reduction (the jump min)
+    and ONE cond (fast-forward vs lockstep) at its top level, nothing
+    else: the propose computation is elementwise + that reduce_min,
+    and the whole lockstep step lives inside the cond branches (so it
+    no longer appears at the top level at all)."""
+    body = _outer_while_body(_cfg())
+    counts = _top_counts(
+        body, ("reduce_min", "cond", "while", "scan", "dot_general",
+               "sort"),
+    )
+    assert counts == {
+        "reduce_min": 1, "cond": 1, "while": 0, "scan": 0,
+        "dot_general": 0, "sort": 0,
+    }, counts
+    # the escape hatch rebuilds the pure lockstep body: phase ops back
+    # at the top level, no jump cond anywhere
+    lockstep = _outer_while_body(dataclasses.replace(_cfg(), elide=False))
+    assert _top_counts(lockstep, ("cond",)) == {"cond": 0}
+    assert len(lockstep.eqns) > len(body.eqns)
+
+
+# -- bit-exactness sweeps ---------------------------------------------
+
+
+def test_bit_exact_plain():
+    cfg = _cfg()
+    on, off = _run_pair(cfg, gen_hot_hit_zipf(cfg, 64, seed=1))
+    _assert_single_exact(on, off)
+    assert on.stats()["elided_cycles"] > 0
+    assert on.stats()["multi_hit_retired"] > 0
+
+
+def test_bit_exact_miss_heavy():
+    # uniform-random global traffic barely elides — the candidate
+    # logic must stay exact when almost every cycle is eventful
+    cfg = _cfg()
+    on, off = _run_pair(cfg, gen_uniform_random(cfg, 48, seed=2))
+    _assert_single_exact(on, off)
+
+
+def test_bit_exact_fault_injection():
+    # the fast-forward must replay the per-cycle PRNG splits exactly
+    cfg = _cfg(
+        fault=FaultModel(drop=0.2, duplicate=0.1, reorder=0.1, seed=7)
+    )
+    on, off = _run_pair(cfg, gen_hot_hit_zipf(cfg, 64, seed=3))
+    _assert_single_exact(on, off)
+    assert on.stats()["elided_cycles"] > 0
+
+
+def test_bit_exact_mesh2d_topology():
+    # deliver_at gating: idle jumps ride the head in-transit stamps
+    cfg = _cfg(interconnect=InterconnectConfig(topology="mesh2d"))
+    on, off = _run_pair(cfg, gen_hot_hit_zipf(cfg, 64, seed=3))
+    _assert_single_exact(on, off)
+    assert on.stats()["elided_cycles"] > 0
+
+
+def _batch_pair(cfg, batch, **kw):
+    on = BatchJaxEngine(cfg, batch, **kw).run()
+    off = BatchJaxEngine(
+        dataclasses.replace(cfg, elide=False), batch, **kw
+    ).run()
+    return on, off
+
+
+def _assert_batch_exact(cfg, on: BatchJaxEngine, off: BatchJaxEngine):
+    for b in range(on.b):
+        assert on.system_final_dumps(b) == off.system_final_dumps(b)
+        assert on.system_snapshots(b) == off.system_snapshots(b)
+    assert _strip(on.stats()) == _strip(off.stats())
+    assert not any(k in off.stats() for k in _ELISION_KEYS)
+
+
+def _zipf_batch(cfg, b, t, seed0=0):
+    return [gen_hot_hit_zipf(cfg, t, seed=seed0 + s) for s in range(b)]
+
+
+def test_bit_exact_batched():
+    cfg = _cfg()
+    batch = _zipf_batch(cfg, 4, 48)
+    on, off = _batch_pair(cfg, batch)
+    _assert_batch_exact(cfg, on, off)
+    assert np.asarray(on.state.cycle).tolist() == \
+        np.asarray(off.state.cycle).tolist()
+    assert on.stats()["elided_cycles"] > 0
+
+
+def test_bit_exact_fused_schedule():
+    cfg = _cfg()
+    batch = _zipf_batch(cfg, 6, 48)
+    on, off = _batch_pair(
+        cfg, batch, schedule=Schedule(resident=2, fused=True)
+    )
+    _assert_batch_exact(cfg, on, off)
+    assert on.occupancy.as_dict()["elided_cycles"] > 0
+    assert "elided_cycles" not in off.occupancy.as_dict()
+
+
+def test_bit_exact_host_loop_schedule():
+    cfg = _cfg()
+    batch = _zipf_batch(cfg, 4, 48)
+    on, off = _batch_pair(
+        cfg, batch, schedule=Schedule(interval=16, fused=False)
+    )
+    _assert_batch_exact(cfg, on, off)
+    assert on.occupancy.as_dict()["elided_cycles"] > 0
+
+
+def test_bit_exact_data_sharded():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 local devices")
+    cfg = _cfg()
+    batch = _zipf_batch(cfg, 4, 48)
+    on, off = _batch_pair(cfg, batch, data_shards=2)
+    _assert_batch_exact(cfg, on, off)
+    assert on.stats()["elided_cycles"] > 0
+    # and sharded == unsharded with elision on (the psum-min jump must
+    # not desync the shard-local schedules)
+    ref = BatchJaxEngine(cfg, batch).run()
+    assert _strip(ref.stats()) == _strip(on.stats())
+    for b in range(on.b):
+        assert on.system_final_dumps(b) == ref.system_final_dumps(b)
+
+
+def test_pallas_lockstep_unaffected_packed_planes():
+    """The Pallas family (packed planes included) accepts the elide
+    knob but runs lockstep: zero elision counters, results identical
+    to the elided XLA run."""
+    from hpa2_tpu.ops.pallas_engine import PallasEngine
+    from hpa2_tpu.utils.trace import traces_to_arrays
+
+    cfg = _cfg()
+    traces = gen_hot_hit_zipf(cfg, 32, seed=5)
+    arrays = traces_to_arrays(cfg, [traces])
+    pal = PallasEngine(
+        cfg, *arrays, block=1, cycles_per_call=8, interpret=True,
+        packed=True,
+    ).run()
+    assert not any(k in pal.stats() for k in _ELISION_KEYS)
+    xla = JaxEngine(cfg, traces).run()
+    assert xla.stats()["elided_cycles"] > 0
+    assert pal.system_final_dumps(0) == xla.final_dumps()
+    assert pal.system_snapshots(0) == xla.snapshots()
+
+
+# -- acceptance: >= 2x device-step reduction --------------------------
+
+
+def test_two_x_step_reduction_on_zipf():
+    """On the Zipf private hot-set workload at spread 8 the elided run
+    must collapse at least half of all simulated cycles — i.e. the
+    device executes <= cycle/2 steps (measured ~3x at these knobs)."""
+    cfg = _cfg()
+    traces = gen_hot_hit_zipf(
+        cfg, 400, seed=3, write_frac=0.3, spread=8.0, tail=0.01
+    )
+    on, off = _run_pair(cfg, traces)
+    _assert_single_exact(on, off)
+    cycle = int(on.state.cycle)
+    elided = on.stats()["elided_cycles"]
+    assert elided >= cycle / 2, (
+        f"only {elided} of {cycle} cycles elided (< 2x step reduction)"
+    )
+
+
+# -- watchdog semantics under elision ---------------------------------
+
+
+def test_watchdog_counts_simulated_cycles():
+    """A stalled system must still trip the watchdog — at the same
+    simulated cycle as lockstep — with elision on: the watchdog
+    measures simulated cycles, not device steps."""
+    cfg = _cfg(
+        fault=FaultModel(drop=1.0, edge_sender=1, edge_receiver=0,
+                         seed=1)
+    )
+    traces = gen_uniform_random(cfg, 16, seed=3)
+    cycles = []
+    for elide in (True, False):
+        eng = JaxEngine(
+            dataclasses.replace(cfg, elide=elide), traces,
+            watchdog_cycles=50,
+        )
+        with pytest.raises(StallDiagnostic) as ei:
+            eng.run()
+        assert "watchdog" in str(ei.value)
+        cycles.append(ei.value.cycle)
+    assert cycles[0] == cycles[1]
+
+
+# -- exact-replay model ----------------------------------------------
+
+
+def test_model_matches_device_counters():
+    cfg = _cfg()
+    traces = gen_hot_hit_zipf(cfg, 96, seed=4)
+    pred = predicted_elision(cfg, traces)
+    eng = JaxEngine(cfg, traces).run()
+    stats = eng.stats()
+    assert pred.cycles == int(eng.state.cycle)
+    assert pred.elided_cycles == stats.get("elided_cycles", 0)
+    assert pred.multi_hit_retired == stats.get("multi_hit_retired", 0)
+    assert pred.device_steps == pred.cycles - pred.elided_cycles
+
+
+def test_model_matches_device_counters_topology():
+    cfg = _cfg(interconnect=InterconnectConfig(topology="mesh2d"))
+    traces = gen_hot_hit_zipf(cfg, 96, seed=4)
+    pred = predicted_elision(cfg, traces)
+    eng = JaxEngine(cfg, traces).run()
+    assert pred.cycles == int(eng.state.cycle)
+    assert pred.elided_cycles == eng.stats().get("elided_cycles", 0)
+
+
+def test_model_per_interval_matches_scheduled_run():
+    """The occupancy-model extension: per-interval elided totals from
+    the batched shared-jump replay sum to — and interval-count with —
+    the real scheduled run's counters."""
+    cfg = _cfg()
+    batch = _zipf_batch(cfg, 3, 80)
+    pred = predicted_batch_elision(cfg, batch, interval=24)
+    eng = BatchJaxEngine(
+        cfg, batch, schedule=Schedule(interval=24, fused=False)
+    ).run()
+    occ = eng.occupancy.as_dict()
+    assert sum(pred.per_interval) == pred.elided_cycles
+    assert pred.elided_cycles == occ["elided_cycles"]
+    assert pred.multi_hit_retired == occ["multi_hit_retired"]
+    assert len(pred.per_interval) == occ["intervals"]
+
+
+def test_elision_table_verifies():
+    from hpa2_tpu.analysis.elision import elision_table
+
+    table, rc = elision_table(procs=4, instrs=64, spreads=(8.0,))
+    assert rc == 0, table
+    assert "exact match" in table

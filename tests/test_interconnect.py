@@ -54,8 +54,15 @@ def _dumps_equal(a, b):
 
 def _stats_agree(a, b):
     # zero-tolerant: the spec omits never-incremented keys, the device
-    # schema always carries the core counters (test_observability.py)
+    # schema always carries the core counters (test_observability.py).
+    # elided_cycles/multi_hit_retired describe how the device *executed*
+    # (event-driven fast-forwards), not what was simulated — the
+    # lockstep spec engine can never report them, and hop latency opens
+    # quiet in-flight gaps that make them nonzero even on uniform
+    # traces, so they are excluded from semantic parity here
     for key in set(a) | set(b):
+        if key in ("elided_cycles", "multi_hit_retired"):
+            continue
         assert a.get(key, 0) == b.get(key, 0), key
 
 
