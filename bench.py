@@ -933,6 +933,128 @@ def topo_main() -> int:
     return 0
 
 
+def protocol_main() -> int:
+    """``bench.py --protocol``: the ISSUE-13 protocol/directory study
+    (PROTO_r13.json).
+
+    A/B of the compiled protocol variants on one sharing-heavy
+    workload: per-protocol run cycles and coherence-event counters
+    (invalidations, MESIF forwards, MOESI ownership transfers), plus
+    the directory-format rows on a wide geometry where limited-pointer
+    overflow and coarse-vector rounding actually fire.  Like the
+    topology study, the numbers are *model* output — deterministic
+    cycle/counter values from the spec engine, a pure function of
+    config + trace — and every row is cross-checked against the XLA
+    engine (dumps + counters must agree exactly) before it is
+    reported.  CPU runs are tagged ``indicative: false`` (nothing here
+    is wall-clock anyway).
+    """
+    import dataclasses
+
+    from hpa2_tpu.config import Semantics, SystemConfig
+    from hpa2_tpu.models.protocol import Instr
+    from hpa2_tpu.models.spec_engine import SpecEngine
+    from hpa2_tpu.ops.engine import JaxEngine
+    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+    def _int(name, default):
+        try:
+            return int(os.environ.get(name, str(default)))
+        except ValueError:
+            return default
+
+    nodes = _int("HPA2_PROTO_NODES", 8)
+    instrs = _int("HPA2_PROTO_INSTRS", 48)
+    batch = _int("HPA2_PROTO_BATCH", 4)
+    wide_nodes = _int("HPA2_PROTO_WIDE_NODES", 18)
+
+    try:
+        import jax
+
+        on_tpu = any("tpu" in str(d).lower() for d in jax.devices())
+    except Exception:
+        on_tpu = False
+
+    def _traces_for(cfg, seed):
+        """Sharing-heavy deterministic workload: uniform random folded
+        onto the first few homes so lines are contended (the regime
+        where the protocols actually differ)."""
+        op, addr, val, _ = gen_uniform_random_arrays(
+            cfg, batch, instrs, seed=seed
+        )
+        addr = addr % (3 * cfg.mem_size)
+        return [
+            [
+                [
+                    Instr("W", int(a), int(v)) if o == 1
+                    else Instr("R", int(a))
+                    for o, a, v in zip(op[b, m], addr[b, m], val[b, m])
+                ]
+                for m in range(cfg.num_procs)
+            ]
+            for b in range(batch)
+        ]
+
+    _KEYS = ("msgs_total", "invalidations", "forwards",
+             "owner_transfers", "dir_overflows", "evictions")
+
+    def _ab_row(cfg, seed):
+        """Summed spec counters over the batch + XLA agreement."""
+        totals = {"cycles": 0}
+        agree = True
+        for traces in _traces_for(cfg, seed):
+            sp = SpecEngine(cfg, traces)
+            sp.run(max_cycles=200_000)
+            st = sp.stats()
+            totals["cycles"] += sp.cycle
+            for k in _KEYS:
+                totals[k] = totals.get(k, 0) + st.get(k, 0)
+            jx = JaxEngine(cfg, traces, max_cycles=200_000).run()
+            agree = agree and (
+                [dataclasses.asdict(d) for d in sp.final_dumps()]
+                == [dataclasses.asdict(d) for d in jx.final_dumps()]
+                and sp.cycle == jx.cycle
+            )
+        totals["spec_jax_agree"] = agree
+        return totals
+
+    sem = Semantics().robust()
+    protocols = {}
+    for protocol in ("mesi", "moesi", "mesif"):
+        cfg = SystemConfig(num_procs=nodes, semantics=sem,
+                           protocol=protocol)
+        protocols[protocol] = _ab_row(cfg, seed=13)
+
+    formats = {}
+    for fmt in ("full", "limited:2", "coarse:4"):
+        cfg = SystemConfig(num_procs=wide_nodes, cache_size=2,
+                           mem_size=8, msg_buffer_size=256,
+                           semantics=sem, directory_format=fmt)
+        formats[fmt] = _ab_row(cfg, seed=9)
+
+    agree = all(r["spec_jax_agree"]
+                for r in list(protocols.values()) + list(formats.values()))
+    mesi_msgs = max(protocols["mesi"]["msgs_total"], 1)
+    result = {
+        "metric": "protocol_traffic_ratio_moesi_over_mesi",
+        "value": round(
+            protocols["moesi"]["msgs_total"] / mesi_msgs, 4
+        ),
+        "unit": "x MESI msgs on the shared-hot workload",
+        "platform": "tpu" if on_tpu else "cpu",
+        "indicative": on_tpu,
+        "nodes": nodes,
+        "wide_nodes": wide_nodes,
+        "instrs_per_core": instrs,
+        "batch": batch,
+        "spec_jax_agree_all": agree,
+        "protocols": protocols,
+        "directory_formats": formats,
+    }
+    print(json.dumps(result))
+    return 0
+
+
 def serve_main() -> int:
     """``bench.py --serve``: the always-on serving benchmark, same
     probe-in-subprocess discipline as the headline bench; always one
@@ -1050,6 +1172,11 @@ def main() -> int:
         # interconnect sensitivity study (ISSUE 11): sized via the
         # HPA2_TOPO_* env knobs; model output, spec/XLA cross-checked
         return topo_main()
+    if "--protocol" in sys.argv:
+        # protocol/directory-format A/B study (ISSUE 13): sized via
+        # the HPA2_PROTO_* env knobs; model output, spec/XLA
+        # cross-checked
+        return protocol_main()
 
     tpu_ok = _probe_tpu()
     result = None

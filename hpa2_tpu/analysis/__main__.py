@@ -34,15 +34,17 @@ _SEMS = {
 }
 
 
-def _table_report(name: str, sem: Semantics, verbose: bool) -> int:
+def _table_report(
+    name: str, sem: Semantics, verbose: bool, protocol: str = "mesi"
+) -> int:
     from hpa2_tpu.analysis.table import build_table
     from hpa2_tpu.analysis.checks import run_static_checks
 
-    table = build_table(sem)
+    table = build_table(sem, protocol)
     findings = run_static_checks(table)
     errors = [f for f in findings if f.severity == "error"]
     warnings = [f for f in findings if f.severity != "error"]
-    print(f"[{name}] {len(table.rows)} rows, "
+    print(f"[{name}/{protocol}] {len(table.rows)} rows, "
           f"{len(table.unreachable)} unreachable declarations, "
           f"{len(errors)} errors, {len(warnings)} warnings")
     shown = findings if verbose else errors
@@ -58,12 +60,14 @@ def cmd_check(args: argparse.Namespace) -> int:
     rc = 0
     for name in args.sem:
         sem = _SEMS[name]()
-        rc += _table_report(name, sem, args.verbose)
-        diffs = diff_backend(build_table(sem), "spec")
-        print(f"[{name}] spec equivalence: {len(diffs)} diffs")
-        for d in diffs[:20]:
-            print(f"  {d}")
-        rc += len(diffs)
+        for protocol in ("mesi", "moesi", "mesif"):
+            rc += _table_report(name, sem, args.verbose, protocol)
+            diffs = diff_backend(build_table(sem, protocol), "spec")
+            print(f"[{name}/{protocol}] spec equivalence: "
+                  f"{len(diffs)} diffs")
+            for d in diffs[:20]:
+                print(f"  {d}")
+            rc += len(diffs)
     return 1 if rc else 0
 
 
@@ -79,31 +83,45 @@ def cmd_lint(args: argparse.Namespace) -> int:
 
 def cmd_equiv(args: argparse.Namespace) -> int:
     from hpa2_tpu.analysis.table import build_table
-    from hpa2_tpu.analysis.extract import diff_backend
+    from hpa2_tpu.analysis.extract import diff_backend, diff_multi_backend
 
     total = 0
     for name in args.sem:
         sem = _SEMS[name]()
-        table = build_table(sem)
-        for backend in args.backends:
-            if (backend in ("jax", "pallas")
-                    and sem.overloaded_evict_shared_notify):
-                # the JAX and Pallas backends refuse to build the
-                # overloaded notify quirk; nothing to extract
-                print(f"[{name}] {backend}: skipped (overloaded quirk "
-                      f"unsupported by this backend)")
-                continue
-            try:
-                diffs = diff_backend(table, backend)
-            except Exception as e:  # e.g. native toolchain missing
-                if backend == "native" and args.allow_missing_native:
-                    print(f"[{name}] native: skipped ({e})")
+        for protocol in args.protocol:
+            tag = f"{name}/{protocol}"
+            table = build_table(sem, protocol)
+            for backend in args.backends:
+                if (backend in ("jax", "pallas")
+                        and sem.overloaded_evict_shared_notify):
+                    # the JAX and Pallas backends refuse to build the
+                    # overloaded notify quirk; nothing to extract
+                    print(f"[{tag}] {backend}: skipped (overloaded "
+                          f"quirk unsupported by this backend)")
                     continue
-                raise
-            print(f"[{name}] {backend}: {len(diffs)} diffs")
-            for d in diffs[:20]:
-                print(f"  {d}")
-            total += len(diffs)
+                if protocol != "mesi" and backend in ("native", "pallas"):
+                    print(f"[{tag}] {backend}: skipped (backend is "
+                          f"specialized to MESI)")
+                    continue
+                try:
+                    diffs = diff_backend(table, backend)
+                except Exception as e:  # e.g. native toolchain missing
+                    if backend == "native" and args.allow_missing_native:
+                        print(f"[{tag}] native: skipped ({e})")
+                        continue
+                    raise
+                print(f"[{tag}] {backend}: {len(diffs)} diffs")
+                for d in diffs[:20]:
+                    print(f"  {d}")
+                total += len(diffs)
+            if "jax" in args.backends \
+                    and not sem.overloaded_evict_shared_notify:
+                diffs = diff_multi_backend(sem, protocol)
+                print(f"[{tag}] multi-message spec<->jax: "
+                      f"{len(diffs)} diffs")
+                for d in diffs[:20]:
+                    print(f"  {d}")
+                total += len(diffs)
     return 1 if total else 0
 
 
@@ -187,19 +205,54 @@ def cmd_topology(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_table(args: argparse.Namespace) -> int:
+    """Lower each requested protocol's TransitionTable and print the
+    compiled plane digests — the same planes the JAX step and the spec
+    dispatch run from, so a digest change here means the kernels
+    changed protocol behavior."""
+    import dataclasses as _dc
+
+    from hpa2_tpu.protocols.compiler import TableCompileError, planes_for
+
+    rc = 0
+    for name in args.sem:
+        sem = _SEMS[name]()
+        for proto in args.protocol:
+            try:
+                planes = planes_for(proto, sem)
+            except TableCompileError as e:
+                print(f"[{name}] {proto}: COMPILE FAILED: {e}")
+                rc += 1
+                continue
+            print(f"[{name}] {proto}: "
+                  f"cache states {','.join(planes.cache_state_names)} | "
+                  f"home states {','.join(planes.home_state_names)} | "
+                  f"digest {planes.digest()}")
+            if args.verbose:
+                for f in _dc.fields(planes):
+                    if f.name in ("protocol", "cache_state_names",
+                                  "home_state_names"):
+                        continue
+                    print(f"    {f.name} = {getattr(planes, f.name)}")
+    return rc
+
+
 def cmd_mutation_test(args: argparse.Namespace) -> int:
     from hpa2_tpu.analysis.mutate import run_all_mutations
 
-    results = run_all_mutations(_SEMS[args.sem[0]]())
-    missed = 0
-    for r in results:
-        status = f"caught by {r.caught_by}" if r.caught else "MISSED"
-        print(f"{r.name:24s} {status}")
-        if args.verbose or not r.caught:
-            for e in r.evidence:
-                print(f"    {e}")
-        missed += 0 if r.caught else 1
-    print(f"{len(results) - missed}/{len(results)} mutations caught")
+    sem = _SEMS[args.sem[0]]()
+    missed = total = 0
+    for protocol in ("mesi", "moesi", "mesif"):
+        results = run_all_mutations(sem, protocol)
+        for r in results:
+            status = f"caught by {r.caught_by}" if r.caught else "MISSED"
+            print(f"[{protocol}] {r.name:24s} {status}")
+            if args.verbose or not r.caught:
+                for e in r.evidence:
+                    print(f"    {e}")
+            missed += 0 if r.caught else 1
+        total += len(results)
+    print(f"{total - missed}/{total} mutations caught")
     return 1 if missed else 0
 
 
@@ -218,10 +271,16 @@ def main(argv=None) -> int:
     ep = sub.add_parser("equiv", help="cross-backend table diff")
     ep.add_argument("--backends", default="spec,jax,native,pallas",
                     help="comma-separated: spec,jax,native,pallas")
+    ep.add_argument("--protocol", default="mesi,moesi,mesif",
+                    help="comma-separated: mesi,moesi,mesif (native/"
+                         "pallas rows are extracted for mesi only)")
     ep.add_argument("--allow-missing-native", action="store_true",
                     help="skip (not fail) when the native build is "
                          "unavailable")
     sub.add_parser("mutation-test", help="analyzer self-test")
+    tbl = sub.add_parser("table", help="print compiled protocol planes")
+    tbl.add_argument("--protocol", default="mesi,moesi,mesif",
+                     help="comma-separated: mesi,moesi,mesif")
     vp = sub.add_parser("vmem", help="static VMEM budget model")
     vp.add_argument("--blocks", default="512,1024,2048",
                     help="comma-separated block widths")
@@ -290,6 +349,12 @@ def main(argv=None) -> int:
     for s in args.sem:
         if s not in _SEMS:
             p.error(f"unknown semantics variant {s!r}")
+    if getattr(args, "cmd", None) in ("table", "equiv"):
+        args.protocol = [x.strip() for x in args.protocol.split(",")
+                         if x.strip()]
+        for x in args.protocol:
+            if x not in ("mesi", "moesi", "mesif"):
+                p.error(f"unknown protocol {x!r}")
     if hasattr(args, "backends"):
         args.backends = [b.strip() for b in args.backends.split(",")]
         for b in args.backends:
@@ -300,6 +365,7 @@ def main(argv=None) -> int:
         "lint": cmd_lint,
         "equiv": cmd_equiv,
         "mutation-test": cmd_mutation_test,
+        "table": cmd_table,
         "vmem": cmd_vmem,
         "occupancy": cmd_occupancy,
         "elision": cmd_elision,

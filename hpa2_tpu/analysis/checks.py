@@ -32,7 +32,6 @@ from typing import Dict, List, Tuple
 
 from hpa2_tpu.config import Semantics
 from hpa2_tpu.analysis.table import (
-    CASE_UNIVERSE,
     MSG_EVENTS,
     REQUEST_EVENTS,
     REPLY_TYPES,
@@ -43,11 +42,14 @@ from hpa2_tpu.analysis.table import (
 VALID_MSG_TYPES = set(MSG_EVENTS)
 VALID_TARGETS = {
     "requester", "owner", "home", "second", "survivor", "sharers",
-    "victim_home",
+    "victim_home", "tracked_owner",
 }
 VALID_SHARER_UPDATES = {
     "", "same", "empty", "requester", "+requester", "-sender", "second",
     "+second",
+}
+VALID_OWNER_UPDATES = {
+    "", "same", "none", "requester", "second", "owner", "drop_sender",
 }
 VALID_VALUE_SRC = {"", "msg", "pending", "instr", "placeholder"}
 
@@ -66,6 +68,23 @@ LEGAL_CACHE_NEXT: Dict[str, Tuple[str, ...]] = {
     "INSTR_R": ("I",),
     "INSTR_W": ("M", "I"),
 }
+
+#: protocol deltas on top of the MESI legal-next sets
+_LEGAL_CACHE_NEXT_DELTA: Dict[str, Dict[str, Tuple[str, ...]]] = {
+    "moesi": {
+        "WRITEBACK_INT": ("O",),        # owner keeps the line as OWNED
+        "UPGRADE_NOTIFY": ("E", "M"),   # O promotes to M, S to E
+    },
+    "mesif": {
+        "REPLY_RD": ("E", "F"),         # fwdf flag fills FORWARD
+        "FLUSH": ("F",),                # cache-to-cache fill becomes F
+    },
+}
+
+
+def legal_cache_next(protocol: str) -> Dict[str, Tuple[str, ...]]:
+    """The per-event legal next-cache-state sets for one protocol."""
+    return {**LEGAL_CACHE_NEXT, **_LEGAL_CACHE_NEXT_DELTA.get(protocol, {})}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,7 +109,7 @@ def _where(role: str, state: str, event: str, case: str = "") -> str:
 def check_completeness(table: TransitionTable) -> List[Finding]:
     out: List[Finding] = []
     claimed = {r.key for r in table.rows}
-    for (role, event), per_state in CASE_UNIVERSE.items():
+    for (role, event), per_state in table.universe.items():
         for state, cases in per_state.items():
             for case in cases:
                 if (role, state, event, case) in claimed:
@@ -108,7 +127,7 @@ def check_completeness(table: TransitionTable) -> List[Finding]:
                 "completeness", "error",
                 _where(u.role, u.state, u.event, u.case),
                 "unreachable declaration carries no reason"))
-        if (u.role, u.event) not in CASE_UNIVERSE:
+        if (u.role, u.event) not in table.universe:
             out.append(Finding(
                 "completeness", "error",
                 _where(u.role, u.state, u.event, u.case),
@@ -126,7 +145,7 @@ def check_determinism(table: TransitionTable) -> List[Finding]:
                 "guard-case claimed by two rows — the transition is "
                 "ambiguous"))
         seen[r.key] = r
-        universe = CASE_UNIVERSE.get((r.role, r.event))
+        universe = table.universe.get((r.role, r.event))
         if universe is None or r.state not in universe:
             out.append(Finding(
                 "determinism", "error", _where(*r.key),
@@ -174,6 +193,18 @@ def check_no_silent_drop(table: TransitionTable) -> List[Finding]:
 def check_state_product(table: TransitionTable) -> List[Finding]:
     out: List[Finding] = []
     for r in table.rows:
+        if r.owner not in VALID_OWNER_UPDATES:
+            out.append(Finding(
+                "state-product", "error", _where(*r.key),
+                f"unknown owner-pointer update {r.owner!r}"))
+        if r.role == "cache" and r.owner not in ("", "same"):
+            out.append(Finding(
+                "state-product", "error", _where(*r.key),
+                "only home rows may update the owner pointer"))
+        if table.protocol == "mesi" and r.owner != "":
+            out.append(Finding(
+                "state-product", "error", _where(*r.key),
+                "MESI has no owner pointer; the row must leave it alone"))
         if r.role == "home":
             if r.sharers not in VALID_SHARER_UPDATES:
                 out.append(Finding(
@@ -190,25 +221,34 @@ def check_state_product(table: TransitionTable) -> List[Finding]:
                 out.append(Finding(
                     "state-product", "error", _where(*r.key),
                     "transition into U must clear the sharer set"))
-            if nxt in ("EM", "S") and upd == "empty":
+            if nxt in ("EM", "S", "SO") and upd == "empty":
                 out.append(Finding(
                     "state-product", "error", _where(*r.key),
                     f"directory {nxt} requires a non-empty sharer set"))
             if nxt == "EM" and upd in ("+requester", "+second", "-sender"):
                 # EM = exactly one holder: additive/subtractive updates
                 # cannot guarantee a singleton — except -sender leaving
-                # exactly one, which the two_sharers case encodes.
-                if r.case != "two_sharers":
+                # exactly one, which the two_sharers / one_left cases
+                # encode.
+                if r.case not in ("two_sharers", "one_left"):
                     out.append(Finding(
                         "state-product", "error", _where(*r.key),
                         f"directory EM requires a singleton sharer set; "
                         f"update {upd!r} cannot guarantee that"))
+            if nxt == "SO" and r.owner in ("none",):
+                out.append(Finding(
+                    "state-product", "error", _where(*r.key),
+                    "directory SO requires a live owner pointer"))
+            if nxt == "U" and r.owner not in ("", "none", "same"):
+                out.append(Finding(
+                    "state-product", "error", _where(*r.key),
+                    "directory U cannot track an owner"))
         else:
             if r.value_src not in VALID_VALUE_SRC:
                 out.append(Finding(
                     "state-product", "error", _where(*r.key),
                     f"unknown value source {r.value_src!r}"))
-            legal = LEGAL_CACHE_NEXT.get(r.event)
+            legal = legal_cache_next(table.protocol).get(r.event)
             if legal is not None and r.next_state != r.state \
                     and r.next_state not in legal:
                 out.append(Finding(
@@ -289,7 +329,7 @@ def check_reply_guarantee(table: TransitionTable) -> List[Finding]:
                       for e in r.emits)
         forwards = [e.type for e in r.emits
                     if e.type in ("WRITEBACK_INT", "WRITEBACK_INV")
-                    and e.to == "owner"]
+                    and e.to in ("owner", "tracked_owner")]
         if replies:
             continue
         if forwards:

@@ -66,8 +66,8 @@ REQUESTER = 2
 OWNER = 3
 OTHER = 0
 
-_CACHE_NUM = {"M": 0, "E": 1, "S": 2, "I": 3}
-_DIR_NUM = {"EM": 0, "S": 1, "U": 2}
+_CACHE_NUM = {"M": 0, "E": 1, "S": 2, "I": 3, "O": 4, "F": 5}
+_DIR_NUM = {"EM": 0, "S": 1, "U": 2, "SO": 3}
 
 #: initial directory sharer masks per (event, state, case) — chosen so
 #: every symbolic update resolves to a distinct concrete mask
@@ -90,6 +90,13 @@ _HOME_SHARERS: Dict[Tuple[str, str], int] = {
     ("FLUSH_INVACK", "EM"): bit(OWNER),
     ("NACK", "S"): bit(OTHER) | bit(OWNER),
     ("NACK", "EM"): bit(OWNER),
+    # MOESI SO cells (the tracked owner stays a sharer by invariant)
+    ("WRITE_REQUEST", "SO"): bit(OTHER) | bit(OWNER),
+    ("UPGRADE", "SO"): bit(OTHER) | bit(REQUESTER) | bit(OWNER),
+    ("FLUSH", "SO"): bit(OTHER) | bit(REQUESTER) | bit(OWNER),
+    ("FLUSH_INVACK", "SO"): bit(OTHER) | bit(REQUESTER) | bit(OWNER),
+    ("NACK", "SO"): bit(OTHER) | bit(OWNER),
+    ("EVICT_MODIFIED", "SO"): bit(OWNER),
 }
 _HOME_SHARERS_BY_CASE: Dict[str, int] = {
     "owner_is_requester": bit(REQUESTER),
@@ -100,6 +107,17 @@ _HOME_SHARERS_BY_CASE: Dict[str, int] = {
     "sender_not_sharer": bit(OTHER) | bit(OWNER),
     "sender_is_owner": bit(REQUESTER),
     "sender_not_owner": bit(OWNER),
+    # MOESI SO eviction cases (sender = REQUESTER, tracked owner = the
+    # staged dir_owner; "sender_is_owner_*" stage the sender as owner)
+    "none_left": bit(REQUESTER),
+    "one_left": bit(REQUESTER) | bit(OWNER),
+    "several_left": bit(OTHER) | bit(REQUESTER) | bit(OWNER),
+    "sender_is_owner_last": bit(REQUESTER),
+    "sender_is_owner_more": bit(REQUESTER) | bit(OTHER),
+    # MESIF forwarder cases on a shared line (requester not a sharer)
+    "no_fwd": bit(OTHER) | bit(OWNER),
+    "fwd_is_requester": bit(REQUESTER) | bit(OTHER),
+    "fwd_other": bit(OTHER) | bit(OWNER),
 }
 
 #: REPLY_ID fan-out mask: includes the receiver itself to prove the
@@ -130,6 +148,7 @@ class Scenario:
     dir_blk: int = 3
     dir_state: int = int(DirState.U)
     dir_sharers: int = 0
+    dir_owner: int = NO_PROC
     mem_blk: int = 3
     mem_value: int = MEM_SENTINEL
     pending: int = PENDING_SENTINEL
@@ -152,6 +171,7 @@ class Observed:
     mem_value: int
     waiting: bool
     emits: List[Tuple]
+    dir_owner: int = NO_PROC
 
     def normalized(self) -> "Observed":
         return dataclasses.replace(
@@ -164,20 +184,50 @@ class Observed:
 # ---------------------------------------------------------------------------
 
 
-def scenario_for(row: Row) -> Optional[Scenario]:
+def scenario_for(row: Row, protocol: str = "mesi") -> Optional[Scenario]:
     """Concrete probe setup for one declared row (None = not probeable:
     the row's guard needs multi-node context the probe cannot stage)."""
     if row.role == "home":
-        return _home_scenario(row)
+        return _home_scenario(row, protocol)
     return _cache_scenario(row)
 
 
-def _home_scenario(row: Row) -> Scenario:
+def _home_owner(row: Row, protocol: str) -> int:
+    """Initial tracked-owner/forwarder pointer for an owner-plane
+    protocol, chosen so every symbolic owner update resolves to a
+    transition the probe can see (set->cleared, set->moved, kept)."""
+    if row.state == "SO":
+        # invariant: the tracked owner is a sharer; "sender_is_owner_*"
+        # cases make the evicting sender (REQUESTER) that owner
+        if row.case in ("owner_is_requester", "sender_is_owner_last",
+                        "sender_is_owner_more"):
+            return REQUESTER
+        return OWNER
+    if protocol == "moesi" or row.state == "U":
+        # MOESI tracks an owner only while SO; staging one elsewhere
+        # would probe an unreachable configuration
+        return NO_PROC
+    if row.case in ("fwd_is_requester", "sender_only_sharer",
+                    "two_sharers", "many_sharers"):
+        return REQUESTER
+    if row.case == "no_fwd":
+        return NO_PROC
+    if row.case == "fwd_other" or row.event in (
+            "NACK", "FLUSH", "FLUSH_INVACK", "WRITE_REQUEST", "UPGRADE"):
+        return OWNER
+    return NO_PROC
+
+
+def _home_scenario(row: Row, protocol: str = "mesi") -> Scenario:
+    from hpa2_tpu.protocols.compiler import planes_for
+
     scn = Scenario(receiver=HOME)
     scn.dir_state = _DIR_NUM[row.state]
     scn.dir_sharers = _HOME_SHARERS_BY_CASE.get(
         row.case, _HOME_SHARERS.get((row.event, row.state), 0)
     )
+    if planes_for(protocol, Semantics()).has_owner_plane:
+        scn.dir_owner = _home_owner(row, protocol)
     scn.msg_type = int(MsgType[row.event])
     scn.msg_sender = REQUESTER
     if row.event in ("FLUSH", "FLUSH_INVACK"):
@@ -220,7 +270,10 @@ def _cache_scenario(row: Row) -> Scenario:
         scn.waiting = True
     if row.event == "REPLY_RD":
         scn.msg_value = MSG_SENTINEL
-        scn.msg_sharers = 2 if case.endswith("excl") else 0
+        # fill-flag wire values: 2 = exclusive, 1 = forward (MESIF), 0
+        # = plain shared
+        scn.msg_sharers = (2 if case.endswith("excl")
+                           else 1 if case.endswith("fwd") else 0)
     elif row.event == "REPLY_ID":
         scn.msg_sharers = _FANOUT_MASK
     elif row.event in ("FLUSH", "FLUSH_INVACK"):
@@ -271,6 +324,8 @@ def _emit_sharers(sym: str, init_sharers: int) -> Optional[int]:
         return None
     if sym == "excl":
         return 2
+    if sym == "fwdf":  # MESIF fill-as-forwarder flag
+        return 1
     if sym in ("shared", "none", "rd"):
         return 0
     if sym == "wr":
@@ -280,16 +335,37 @@ def _emit_sharers(sym: str, init_sharers: int) -> Optional[int]:
     raise ValueError(f"unknown emission sharer symbol {sym!r}")
 
 
+def _resolve_owner(update: str, scn: Scenario) -> int:
+    from hpa2_tpu.models.protocol import find_owner
+
+    if update in ("", "same"):
+        return scn.dir_owner
+    if update == "none":
+        return NO_PROC
+    if update == "requester":
+        return REQUESTER
+    if update == "second":
+        return scn.msg_second
+    if update == "owner":  # the EM owner, found from the sharer mask
+        return find_owner(scn.dir_sharers)
+    if update == "drop_sender":
+        return (NO_PROC if scn.dir_owner == scn.msg_sender
+                else scn.dir_owner)
+    raise ValueError(f"unknown owner update {update!r}")
+
+
 def expected_for(row: Row, scn: Scenario) -> Observed:
     if row.role == "home":
         dir_state = _DIR_NUM[row.next_state]
         dir_sharers = _resolve_sharers(
             row.sharers, scn.dir_sharers, scn.msg_second
         )
+        dir_owner = _resolve_owner(row.owner, scn)
         line = (scn.line_addr, scn.line_value, scn.line_state)
     else:
         dir_state = scn.dir_state
         dir_sharers = scn.dir_sharers
+        dir_owner = scn.dir_owner
         fill = {"msg": MSG_SENTINEL, "pending": PENDING_SENTINEL,
                 "instr": INSTR_SENTINEL, "placeholder": 0}
         if row.value_src:
@@ -306,6 +382,7 @@ def expected_for(row: Row, scn: Scenario) -> Observed:
         "requester": REQUESTER, "owner": OWNER, "home": HOME,
         "second": scn.msg_second, "survivor": OWNER,
         "victim_home": VICTIM_ADDR // 16,
+        "tracked_owner": scn.dir_owner,
     }
     seconds = {"": None, "requester": REQUESTER, "fwd": scn.msg_second}
     for e in row.emits:
@@ -322,7 +399,7 @@ def expected_for(row: Row, scn: Scenario) -> Observed:
     return Observed(
         line_addr=line[0], line_value=line[1], line_state=line[2],
         dir_state=dir_state, dir_sharers=dir_sharers, mem_value=mem,
-        waiting=waiting, emits=emits,
+        waiting=waiting, emits=emits, dir_owner=dir_owner,
     ).normalized()
 
 
@@ -331,11 +408,8 @@ def expected_for(row: Row, scn: Scenario) -> Observed:
 # ---------------------------------------------------------------------------
 
 
-def probe_spec(scn: Scenario, sem: Semantics) -> Observed:
-    from hpa2_tpu.models.spec_engine import SpecEngine
-
-    cfg = SystemConfig(semantics=sem)
-    eng = SpecEngine(cfg, [[] for _ in range(cfg.num_procs)])
+def _stage_spec_node(eng, scn: Scenario) -> None:
+    """Write one scenario's receiver-node state into a SpecEngine."""
     node = eng.nodes[scn.receiver]
     line = node.cache[scn.line_index]
     line.address = scn.line_addr
@@ -344,9 +418,23 @@ def probe_spec(scn: Scenario, sem: Semantics) -> Observed:
     entry = node.directory[scn.dir_blk]
     entry.state = DirState(scn.dir_state)
     entry.sharers = scn.dir_sharers
+    entry.owner = scn.dir_owner
     node.memory[scn.mem_blk] = scn.mem_value
     node.pending_write = scn.pending
     node.waiting = scn.waiting
+
+
+def probe_spec(
+    scn: Scenario, sem: Semantics, protocol: str = "mesi"
+) -> Observed:
+    from hpa2_tpu.models.spec_engine import SpecEngine
+
+    cfg = SystemConfig(semantics=sem, protocol=protocol)
+    eng = SpecEngine(cfg, [[] for _ in range(cfg.num_procs)])
+    _stage_spec_node(eng, scn)
+    node = eng.nodes[scn.receiver]
+    line = node.cache[scn.line_index]
+    entry = node.directory[scn.dir_blk]
     if scn.is_instr:
         node.trace = [Instr(scn.instr_op, scn.instr_addr, scn.instr_value)]
         node.pc = 0
@@ -364,7 +452,7 @@ def probe_spec(scn: Scenario, sem: Semantics) -> Observed:
     return Observed(
         line_addr=line.address, line_value=line.value,
         line_state=int(line.state), dir_state=int(entry.state),
-        dir_sharers=entry.sharers,
+        dir_sharers=entry.sharers, dir_owner=entry.owner,
         mem_value=node.memory[scn.mem_blk], waiting=node.waiting,
         emits=emits,
     ).normalized()
@@ -402,11 +490,11 @@ def _native_packed(scn: Scenario) -> List[int]:
 class JaxProber:
     """Shared jitted step for a batch of JAX probes (one compile)."""
 
-    def __init__(self, sem: Semantics):
+    def __init__(self, sem: Semantics, protocol: str = "mesi"):
         from hpa2_tpu.ops.step import build_step_jitted
         from hpa2_tpu.ops.state import init_state
 
-        self.cfg = SystemConfig(semantics=sem)
+        self.cfg = SystemConfig(semantics=sem, protocol=protocol)
         self.step = build_step_jitted(self.cfg)
         # one instruction slot so msg- and instr-probes share shapes
         # (init_state pads empty traces to length 1)
@@ -414,14 +502,10 @@ class JaxProber:
             self.cfg, [[] for _ in range(self.cfg.num_procs)]
         )
 
-    def probe(self, scn: Scenario) -> Observed:
+    def _stage(self, st, scn: Scenario):
+        """Write one scenario's receiver-node state into a SimState."""
         import numpy as np
 
-        from hpa2_tpu.ops.state import (
-            MB_ADDR, MB_SECOND, MB_SENDER, MB_SHARERS, MB_TYPE, MB_VALUE,
-        )
-
-        st = self.base
         r = scn.receiver
         st = st._replace(
             cache_addr=st.cache_addr.at[r, scn.line_index].set(scn.line_addr),
@@ -431,6 +515,7 @@ class JaxProber:
             dir_state=st.dir_state.at[r, scn.dir_blk].set(scn.dir_state),
             dir_sharers=st.dir_sharers.at[r, scn.dir_blk, 0].set(
                 scn.dir_sharers),
+            dir_owner=st.dir_owner.at[r, scn.dir_blk].set(scn.dir_owner),
             mem=st.mem.at[r, scn.mem_blk].set(scn.mem_value),
             pending_write=st.pending_write.at[r].set(scn.pending),
             waiting=st.waiting.at[r].set(scn.waiting),
@@ -451,7 +536,17 @@ class JaxProber:
                     np.asarray(packed, dtype=np.int32)),
                 mb_count=st.mb_count.at[r].set(1),
             )
-        nxt = self.step(st)
+        return st
+
+    def probe(self, scn: Scenario) -> Observed:
+        import numpy as np
+
+        from hpa2_tpu.ops.state import (
+            MB_ADDR, MB_SECOND, MB_SENDER, MB_SHARERS, MB_TYPE, MB_VALUE,
+        )
+
+        r = scn.receiver
+        nxt = self.step(self._stage(self.base, scn))
         emits = []
         for j in range(self.cfg.num_procs):
             if j == r:
@@ -467,6 +562,7 @@ class JaxProber:
             line_state=int(nxt.cache_state[r, scn.line_index]),
             dir_state=int(nxt.dir_state[r, scn.dir_blk]),
             dir_sharers=int(nxt.dir_sharers[r, scn.dir_blk, 0]),
+            dir_owner=int(nxt.dir_owner[r, scn.dir_blk]),
             mem_value=int(nxt.mem[r, scn.mem_blk]),
             waiting=bool(nxt.waiting[r]),
             emits=emits,
@@ -625,7 +721,7 @@ class PallasProber:
 def _diff_observed(where: str, exp: Observed, obs: Observed) -> List[str]:
     out = []
     for field in ("line_addr", "line_value", "line_state", "dir_state",
-                  "dir_sharers", "mem_value", "waiting"):
+                  "dir_sharers", "dir_owner", "mem_value", "waiting"):
         e, o = getattr(exp, field), getattr(obs, field)
         if e != o:
             out.append(f"{where}: {field} expected {e} observed {o}")
@@ -654,26 +750,34 @@ def diff_backend(
     table: TransitionTable,
     backend: str,
     rows: Optional[Sequence[Row]] = None,
+    prober=None,
 ) -> List[str]:
     """Diff the backend's effective table against the declared one.
 
     Returns one human-readable line per mismatch (empty = equivalent).
+    ``prober`` lets callers reuse a compiled ``JaxProber`` /
+    ``PallasProber`` across many diffs (e.g. the fuzzer).
     """
     sem = table.semantics
+    protocol = table.protocol
+    if protocol != "mesi" and backend in ("native", "pallas"):
+        raise ValueError(
+            f"the {backend} backend is specialized to MESI; "
+            f"cannot extract a {protocol} table from it")
     rows = list(rows) if rows is not None else probeable_rows(table)
     diffs: List[str] = []
-    prober = None
-    if backend == "jax":
-        prober = JaxProber(sem)
-    elif backend == "pallas":
-        prober = PallasProber(sem)
+    if prober is None:
+        if backend == "jax":
+            prober = JaxProber(sem, protocol)
+        elif backend == "pallas":
+            prober = PallasProber(sem)
     for row in rows:
-        scn = scenario_for(row)
+        scn = scenario_for(row, protocol)
         if scn is None:
             continue
         exp = expected_for(row, scn)
         if backend == "spec":
-            obs = probe_spec(scn, sem)
+            obs = probe_spec(scn, sem, protocol)
         elif backend in ("jax", "pallas"):
             obs = prober.probe(scn)
         elif backend == "native":
@@ -685,7 +789,229 @@ def diff_backend(
 
 
 def extract_and_diff(
-    sem: Semantics, backends: Sequence[str]
+    sem: Semantics, backends: Sequence[str], protocol: str = "mesi"
 ) -> Dict[str, List[str]]:
-    table = build_table(sem)
+    table = build_table(sem, protocol)
     return {b: diff_backend(table, b) for b in backends}
+
+
+# ---------------------------------------------------------------------------
+# multi-stimulus probes: several deliveries in one phase.  The per-row
+# probes above stage exactly one stimulus, so they can never see how
+# concurrent handlers interact — emission ordering into a shared
+# mailbox, two directory mutations racing an in-flight intervention.
+# These scenarios stage stimuli at two or three DISTINCT receivers
+# (the lockstep step handles one message per node per cycle), run one
+# full cycle on both backends, and diff the ENTIRE system: every
+# node's architectural state plus every mailbox's exact content and
+# order.  The spec engine is the pivot; zero diffs expected.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MultiScenario:
+    """Named bundle of single-node scenarios fired in the same cycle."""
+
+    name: str
+    stimuli: Tuple[Scenario, ...]
+
+
+def multi_scenarios(protocol: str, sem: Semantics) -> List[MultiScenario]:
+    """Same-phase interaction scenarios for one protocol (all same
+    address unless noted, receivers always distinct)."""
+    C, D = _CACHE_NUM, _DIR_NUM
+    mesif = protocol == "mesif"
+    moesi = protocol == "moesi"
+    # MESIF read fills always carry a flag (2 = exclusive, 1 = as-
+    # forwarder); a plain shared fill (0) exists only in MESI/MOESI
+    rd_fill_flag = 1 if mesif else 0
+
+    def msg(receiver: int, mtype: str, sender: int, **kw) -> Scenario:
+        return Scenario(receiver=receiver, msg_type=int(MsgType[mtype]),
+                        msg_sender=sender, **kw)
+
+    out = [
+        # a new read arrives at home while the owner is already
+        # answering an earlier intervention for the same line
+        MultiScenario("read_x_owner_wbint", (
+            msg(HOME, "READ_REQUEST", OTHER,
+                dir_state=D["EM"], dir_sharers=bit(OWNER)),
+            msg(OWNER, "WRITEBACK_INT", HOME, msg_second=REQUESTER,
+                line_addr=ADDR, line_value=LINE_SENTINEL,
+                line_state=C["M"]),
+        )),
+        # a sharer eviction reaches home while another sharer handles
+        # the INV of a racing write fan-out
+        MultiScenario("evict_x_inv", (
+            msg(HOME, "EVICT_SHARED", OTHER,
+                dir_state=D["S"],
+                dir_sharers=bit(OTHER) | bit(REQUESTER) | bit(OWNER),
+                dir_owner=OWNER if mesif else NO_PROC),
+            msg(REQUESTER, "INV", OWNER,
+                line_addr=ADDR, line_value=LINE_SENTINEL,
+                line_state=C["S"]),
+        )),
+        # home serves a write while the last-survivor notify of an
+        # earlier eviction is still being absorbed
+        MultiScenario("write_x_notify", (
+            msg(HOME, "WRITE_REQUEST", REQUESTER, msg_value=MSG_SENTINEL,
+                dir_state=D["S"], dir_sharers=bit(OTHER) | bit(OWNER),
+                dir_owner=OWNER if mesif else NO_PROC),
+            msg(OWNER, "UPGRADE_NOTIFY", HOME,
+                line_addr=ADDR, line_value=LINE_SENTINEL,
+                line_state=C["S"]),
+        )),
+        # a cache upgrades (hit on S) in the same cycle home shrinks
+        # the sharer set under it
+        MultiScenario("upgrade_x_evict", (
+            Scenario(receiver=REQUESTER, is_instr=True, instr_op="W",
+                     instr_value=INSTR_SENTINEL, line_addr=ADDR,
+                     line_value=LINE_SENTINEL, line_state=C["S"]),
+            msg(HOME, "EVICT_SHARED", OTHER,
+                dir_state=D["S"],
+                dir_sharers=bit(OTHER) | bit(REQUESTER),
+                dir_owner=REQUESTER if mesif else NO_PROC),
+        )),
+        # two homes answer the same requester in one phase: pins the
+        # cross-backend delivery order into a shared mailbox
+        MultiScenario("two_replies_one_requester", (
+            msg(HOME, "READ_REQUEST", REQUESTER, dir_state=D["U"]),
+            msg(VICTIM_ADDR // 16, "WRITE_REQUEST", REQUESTER,
+                msg_addr=VICTIM_ADDR, msg_value=MSG_SENTINEL,
+                dir_state=D["U"]),
+        )),
+    ]
+    if sem.intervention_miss_policy == "nack":
+        # home re-serves a NACKed read while the requester is filling
+        # from an earlier (stale) reply; NACK is never emitted under
+        # the drop policy, so the race only exists on robust builds
+        out.append(MultiScenario("nack_x_fill", (
+            msg(HOME, "NACK", OWNER, msg_second=REQUESTER,
+                dir_state=D["S"], dir_sharers=bit(OTHER),
+                dir_owner=OWNER if mesif else NO_PROC),
+            msg(REQUESTER, "REPLY_RD", HOME, msg_value=MSG_SENTINEL,
+                msg_sharers=rd_fill_flag, line_addr=ADDR,
+                line_state=C["I"], waiting=True),
+        )))
+    if moesi or mesif:
+        # a tracked owner/forwarder answers one intervention while
+        # home, still pointing at it, forwards the next
+        out.append(MultiScenario("tracked_read_x_owner_wbint", (
+            msg(HOME, "READ_REQUEST", REQUESTER,
+                dir_state=D["SO"] if moesi else D["S"],
+                dir_sharers=bit(OTHER) | bit(OWNER), dir_owner=OWNER),
+            msg(OWNER, "WRITEBACK_INT", HOME, msg_second=OTHER,
+                line_addr=ADDR, line_value=LINE_SENTINEL,
+                line_state=C["O"] if moesi else C["F"]),
+        )))
+    return out
+
+
+def _spec_system_obs(eng) -> List[dict]:
+    return [
+        {
+            "mem": [int(x) for x in n.memory],
+            "dir": [[int(e.state), int(e.sharers), int(e.owner)]
+                    for e in n.directory],
+            "cache": [[int(l.address), int(l.value), int(l.state)]
+                      for l in n.cache],
+            "pc": int(n.pc),
+            "waiting": bool(n.waiting),
+            "pending": int(n.pending_write),
+            "mailbox": [
+                [int(m.type), int(m.sender), int(m.address),
+                 int(m.value), int(m.sharers), int(m.second_receiver)]
+                for m in n.mailbox
+            ],
+        }
+        for n in eng.nodes
+    ]
+
+
+def probe_spec_multi(
+    ms: MultiScenario, sem: Semantics, protocol: str = "mesi"
+) -> List[dict]:
+    from hpa2_tpu.models.spec_engine import SpecEngine
+
+    cfg = SystemConfig(semantics=sem, protocol=protocol)
+    eng = SpecEngine(cfg, [[] for _ in range(cfg.num_procs)])
+    for scn in ms.stimuli:
+        _stage_spec_node(eng, scn)
+        node = eng.nodes[scn.receiver]
+        if scn.is_instr:
+            node.trace = [
+                Instr(scn.instr_op, scn.instr_addr, scn.instr_value)
+            ]
+            node.pc = 0
+        else:
+            node.mailbox.append(Message(
+                MsgType(scn.msg_type), scn.msg_sender, scn.msg_addr,
+                value=scn.msg_value, sharers=scn.msg_sharers,
+                second_receiver=scn.msg_second,
+            ))
+    eng.step()
+    return _spec_system_obs(eng)
+
+
+def _jax_system_obs(prober: JaxProber, nxt) -> List[dict]:
+    import numpy as np
+
+    from hpa2_tpu.ops.state import (
+        MB_ADDR, MB_SECOND, MB_SENDER, MB_SHARERS, MB_TYPE, MB_VALUE,
+    )
+
+    out = []
+    for j in range(prober.cfg.num_procs):
+        box = []
+        for k in range(int(nxt.mb_count[j])):
+            row = np.asarray(nxt.mb_data[j, k])
+            box.append([int(row[MB_TYPE]), int(row[MB_SENDER]),
+                        int(row[MB_ADDR]), int(row[MB_VALUE]),
+                        int(row[MB_SHARERS]), int(row[MB_SECOND])])
+        out.append({
+            "mem": [int(x) for x in np.asarray(nxt.mem[j])],
+            "dir": [[int(s), int(sh), int(ow)] for s, sh, ow in zip(
+                np.asarray(nxt.dir_state[j]),
+                np.asarray(nxt.dir_sharers[j, :, 0]),
+                np.asarray(nxt.dir_owner[j]))],
+            "cache": [[int(a), int(v), int(s)] for a, v, s in zip(
+                np.asarray(nxt.cache_addr[j]),
+                np.asarray(nxt.cache_val[j]),
+                np.asarray(nxt.cache_state[j]))],
+            "pc": int(nxt.pc[j]),
+            "waiting": bool(nxt.waiting[j]),
+            "pending": int(nxt.pending_write[j]),
+            "mailbox": box,
+        })
+    return out
+
+
+def probe_jax_multi(ms: MultiScenario, prober: JaxProber) -> List[dict]:
+    st = prober.base
+    for scn in ms.stimuli:
+        st = prober._stage(st, scn)
+    return _jax_system_obs(prober, prober.step(st))
+
+
+def diff_multi_backend(
+    sem: Semantics, protocol: str = "mesi"
+) -> List[str]:
+    """Spec-vs-JAX whole-system diff over the same-phase interaction
+    scenarios.  One line per mismatching (node, plane); empty list =
+    the backends agree on every concurrent-handler interaction."""
+    prober = JaxProber(sem, protocol)
+    diffs: List[str] = []
+    for ms in multi_scenarios(protocol, sem):
+        receivers = [s.receiver for s in ms.stimuli]
+        if len(set(receivers)) != len(receivers):
+            raise ValueError(
+                f"{ms.name}: stimuli must target distinct receivers")
+        spec = probe_spec_multi(ms, sem, protocol)
+        jax = probe_jax_multi(ms, prober)
+        for j, (a, b) in enumerate(zip(spec, jax)):
+            for key in a:
+                if a[key] != b[key]:
+                    diffs.append(
+                        f"{ms.name}: node {j} {key} "
+                        f"spec {a[key]} jax {b[key]}")
+    return diffs

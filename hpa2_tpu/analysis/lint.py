@@ -437,6 +437,48 @@ class _DtypeWideningVisitor(ast.NodeVisitor):
 # ---------------------------------------------------------------------------
 
 
+class _HandWrittenStateVisitor(ast.NodeVisitor):
+    """Ban hand-written protocol state constants in the device step
+    and the Pallas kernel (ISSUE-13).  Those modules must resolve
+    every cache/directory state through the compiled ``ProtocolPlanes``
+    (hpa2_tpu/protocols/compiler.py) so the TransitionTable stays the
+    single source of truth; a ``CacheState.MODIFIED`` literal here is
+    a second, silently divergent copy of the protocol."""
+
+    _BANNED = ("CacheState", "DirState")
+
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[LintFinding] = []
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for alias in node.names:
+            if alias.name in self._BANNED:
+                self.findings.append(LintFinding(
+                    "hand-written-state", self.path, node.lineno,
+                    f"imports {alias.name} — kernel state constants "
+                    f"must come from the compiled ProtocolPlanes "
+                    f"(hpa2_tpu/protocols), not the enums"))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (isinstance(node.value, ast.Name)
+                and node.value.id in self._BANNED):
+            self.findings.append(LintFinding(
+                "hand-written-state", self.path, node.lineno,
+                f"hand-written state constant {node.value.id}."
+                f"{node.attr} — use the compiled ProtocolPlanes "
+                f"lookup instead"))
+        self.generic_visit(node)
+
+
+#: ops modules that must be fully plane-driven (relative paths)
+_PLANE_DRIVEN = (
+    os.path.join("hpa2_tpu", "ops", "step.py"),
+    os.path.join("hpa2_tpu", "ops", "pallas_engine.py"),
+)
+
+
 def _lint_dispatch(path: str, tree: ast.Module) -> List[LintFinding]:
     findings: List[LintFinding] = []
     for cls in tree.body:
@@ -533,6 +575,10 @@ def lint_file(repo_root: str, rel: str) -> List[LintFinding]:
         findings.extend(dw.findings)
     if rel.endswith(os.path.join("models", "spec_engine.py")):
         findings.extend(_lint_dispatch(rel, tree))
+    if any(rel.endswith(p) or rel == p for p in _PLANE_DRIVEN):
+        hs = _HandWrittenStateVisitor(rel)
+        hs.visit(tree)
+        findings.extend(hs.findings)
     return findings
 
 

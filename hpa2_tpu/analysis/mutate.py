@@ -14,7 +14,8 @@ CLI: ``python -m hpa2_tpu.analysis mutation-test``.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, List, NamedTuple
+import random
+from typing import Callable, List, NamedTuple, Optional, Tuple
 
 from hpa2_tpu.config import Semantics
 from hpa2_tpu.analysis.table import Emit, Row, TransitionTable, build_table
@@ -139,15 +140,17 @@ class MutationResult:
     evidence: List[str]  # first few findings / diff lines
 
 
-def run_mutation(mut: Mutation, sem: Semantics) -> MutationResult:
-    table = mut.apply(build_table(sem))
+def run_mutation(
+    mut: Mutation, sem: Semantics, protocol: str = "mesi"
+) -> MutationResult:
+    table = mut.apply(build_table(sem, protocol))
     static_errors = [
         str(f) for f in run_static_checks(table) if f.severity == "error"
     ]
     if static_errors:
         return MutationResult(mut.name, True, "static", static_errors[:3])
     # statically plausible table — the behavioral diff must object
-    mutated_keys = _changed_keys(build_table(sem), table)
+    mutated_keys = _changed_keys(build_table(sem, protocol), table)
     rows = [r for r in table.rows
             if r.key in mutated_keys and not table.is_unreachable(*r.key)]
     diffs = diff_backend(table, "spec", rows=rows or None)
@@ -164,6 +167,210 @@ def _changed_keys(base: TransitionTable, mutated: TransitionTable):
     }
 
 
-def run_all_mutations(sem: Semantics = None) -> List[MutationResult]:
+def run_all_mutations(
+    sem: Semantics = None, protocol: str = "mesi"
+) -> List[MutationResult]:
     sem = sem if sem is not None else Semantics()
-    return [run_mutation(m, sem) for m in MUTATIONS]
+    return [run_mutation(m, sem, protocol) for m in MUTATIONS]
+
+
+# ---------------------------------------------------------------------------
+# seeded cross-protocol fuzzing.  The curated set above encodes twelve
+# KNOWN defect shapes; the fuzzer samples the space between them: it
+# draws a random probeable row from any protocol's table and applies a
+# random surgical corruption chosen to be semantically visible (the
+# generators reject identity rewrites, e.g. a sharer update whose
+# resolution equals the original under the probe scenario).  Every
+# sample must be caught — statically, by the spec probe diff, or by
+# the JAX probe diff — so the assertion is the same as the curated
+# set's, over hundreds of machine-chosen bugs per protocol.
+# ---------------------------------------------------------------------------
+
+_FUZZ_EMIT_TYPES = (
+    "REPLY_RD", "REPLY_WR", "REPLY_ID", "INV", "WRITEBACK_INT",
+    "WRITEBACK_INV", "UPGRADE_NOTIFY", "FLUSH", "FLUSH_INVACK",
+)
+_FUZZ_FILLS = ("msg", "pending", "instr", "placeholder")
+_FUZZ_SHARERS = ("empty", "requester", "+requester", "-sender", "same")
+_FUZZ_OWNERS = ("none", "requester", "same", "second")
+
+
+def _fuzz_next_state(
+    rng: random.Random, table: TransitionTable, row: Row
+) -> Optional[Row]:
+    states = (table.home_states if row.role == "home"
+              else table.cache_states)
+    choices = [s for s in states if s != row.next_state]
+    if row.drop or not choices:
+        return None
+    return dataclasses.replace(row, next_state=rng.choice(choices))
+
+
+def _fuzz_drop_emits(
+    rng: random.Random, table: TransitionTable, row: Row
+) -> Optional[Row]:
+    if not row.emits:
+        return None
+    return dataclasses.replace(row, emits=())
+
+
+def _fuzz_emit_type(
+    rng: random.Random, table: TransitionTable, row: Row
+) -> Optional[Row]:
+    if not row.emits:
+        return None
+    i = rng.randrange(len(row.emits))
+    e = row.emits[i]
+    new_type = rng.choice([t for t in _FUZZ_EMIT_TYPES if t != e.type])
+    emits = list(row.emits)
+    emits[i] = dataclasses.replace(e, type=new_type)
+    return dataclasses.replace(row, emits=tuple(emits))
+
+
+def _fuzz_fill_source(
+    rng: random.Random, table: TransitionTable, row: Row
+) -> Optional[Row]:
+    if row.role != "cache" or not row.value_src:
+        return None
+    return dataclasses.replace(
+        row, value_src=rng.choice(
+            [f for f in _FUZZ_FILLS if f != row.value_src]))
+
+
+def _fuzz_lost_wakeup(
+    rng: random.Random, table: TransitionTable, row: Row
+) -> Optional[Row]:
+    if not row.clears_waiting:
+        return None
+    return dataclasses.replace(row, clears_waiting=False)
+
+
+def _fuzz_forget_memory(
+    rng: random.Random, table: TransitionTable, row: Row
+) -> Optional[Row]:
+    if not row.writes_memory:
+        return None
+    return dataclasses.replace(row, writes_memory=False)
+
+
+def _fuzz_sharers(
+    rng: random.Random, table: TransitionTable, row: Row
+) -> Optional[Row]:
+    from hpa2_tpu.analysis.extract import _resolve_sharers, scenario_for
+
+    if row.role != "home" or row.drop:
+        return None
+    scn = scenario_for(row, table.protocol)
+    old = _resolve_sharers(row.sharers, scn.dir_sharers, scn.msg_second)
+    choices = [
+        s for s in _FUZZ_SHARERS
+        if _resolve_sharers(s, scn.dir_sharers, scn.msg_second) != old
+    ]
+    if not choices:
+        return None
+    return dataclasses.replace(row, sharers=rng.choice(choices))
+
+
+def _fuzz_owner(
+    rng: random.Random, table: TransitionTable, row: Row
+) -> Optional[Row]:
+    from hpa2_tpu.protocols.compiler import planes_for
+    from hpa2_tpu.analysis.extract import _resolve_owner, scenario_for
+
+    if row.role != "home" or row.drop:
+        return None
+    if not planes_for(table.protocol, table.semantics).has_owner_plane:
+        return None
+    scn = scenario_for(row, table.protocol)
+    old = _resolve_owner(row.owner, scn)
+    choices = [s for s in _FUZZ_OWNERS
+               if _resolve_owner(s, scn) != old]
+    if not choices:
+        return None
+    return dataclasses.replace(row, owner=rng.choice(choices))
+
+
+def _fuzz_delete(
+    rng: random.Random, table: TransitionTable, row: Row
+) -> Optional[Row]:
+    return None  # sentinel handled in random_mutation (row removal)
+
+
+_FUZZ_KINDS: List[Tuple[str, Callable]] = [
+    ("next-state", _fuzz_next_state),
+    ("drop-emits", _fuzz_drop_emits),
+    ("emit-type", _fuzz_emit_type),
+    ("fill-source", _fuzz_fill_source),
+    ("lost-wakeup", _fuzz_lost_wakeup),
+    ("forget-memory", _fuzz_forget_memory),
+    ("sharers-update", _fuzz_sharers),
+    ("owner-update", _fuzz_owner),
+    ("delete-row", _fuzz_delete),
+]
+
+
+def random_mutation(
+    rng: random.Random, table: TransitionTable, max_tries: int = 64
+) -> Tuple[str, TransitionTable]:
+    """One random visible corruption of ``table`` (name, mutated)."""
+    candidates = [r for r in table.rows
+                  if not table.is_unreachable(*r.key)]
+    for _ in range(max_tries):
+        row = rng.choice(candidates)
+        kind, gen = _FUZZ_KINDS[rng.randrange(len(_FUZZ_KINDS))]
+        name = f"{kind}@{'/'.join(row.key)}"
+        if kind == "delete-row":
+            if row.drop:
+                continue  # deleting a drop row may be a silent no-op
+            return name, dataclasses.replace(
+                table, rows=[r for r in table.rows if r is not row])
+        new = gen(rng, table, row)
+        if new is None or new == row:
+            continue
+        return name, table.replaced(row, new)
+    raise RuntimeError("no applicable mutation found (table too small?)")
+
+
+def run_fuzz(
+    sem: Semantics,
+    protocol: str = "mesi",
+    seed: int = 0,
+    count: int = 100,
+    with_jax: bool = True,
+) -> List[MutationResult]:
+    """``count`` seeded random corruptions of one protocol's table;
+    each must be caught statically or by a backend probe diff."""
+    from hpa2_tpu.analysis.extract import JaxProber
+
+    rng = random.Random(seed)
+    base = build_table(sem, protocol)
+    prober = JaxProber(sem, protocol) if with_jax else None
+    results = []
+    for _ in range(count):
+        name, table = random_mutation(rng, base)
+        static_errors = [
+            str(f) for f in run_static_checks(table)
+            if f.severity == "error"
+        ]
+        if static_errors:
+            results.append(
+                MutationResult(name, True, "static", static_errors[:3]))
+            continue
+        mutated_keys = _changed_keys(base, table)
+        rows = [r for r in table.rows
+                if r.key in mutated_keys
+                and not table.is_unreachable(*r.key)]
+        diffs = diff_backend(table, "spec", rows=rows or None)
+        if diffs:
+            results.append(
+                MutationResult(name, True, "spec-diff", diffs[:3]))
+            continue
+        if prober is not None:
+            diffs = diff_backend(
+                table, "jax", rows=rows or None, prober=prober)
+            if diffs:
+                results.append(
+                    MutationResult(name, True, "jax-diff", diffs[:3]))
+                continue
+        results.append(MutationResult(name, False, "", []))
+    return results

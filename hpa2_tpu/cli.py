@@ -119,6 +119,20 @@ def _build_config(args) -> SystemConfig:
                 "non-ideal topologies run single-shard only; "
                 "--node-shards composes with --topology ideal"
             )
+    protocol = getattr(args, "protocol", "mesi")
+    directory_format = getattr(args, "directory_format", "full")
+    if protocol != "mesi" or directory_format != "full":
+        if backend in ("pallas", "omp"):
+            raise SystemExit(
+                "protocol/directory-format variants are implemented by "
+                "the spec and jax backends (the pallas kernel and the "
+                "native engine are specialized to MESI/full-bitvector)"
+            )
+        if getattr(args, "node_shards", 1) != 1:
+            raise SystemExit(
+                "--node-shards runs the MESI/full-bitvector build only; "
+                "protocol variants compose with single-shard jax/spec"
+            )
     return SystemConfig(
         num_procs=args.nodes,
         cache_size=args.cache_size,
@@ -128,6 +142,8 @@ def _build_config(args) -> SystemConfig:
         messages_per_cycle=k,
         semantics=sem,
         interconnect=interconnect,
+        protocol=protocol,
+        directory_format=directory_format,
     )
 
 
@@ -710,6 +726,22 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="lockstep schedule: messages drained per node per cycle "
         "(spec backend; >1 shortens latency chains on queue-bound "
         "workloads)",
+    )
+    p.add_argument(
+        "--protocol", default="mesi",
+        choices=("mesi", "moesi", "mesif"),
+        help="coherence protocol variant, compiled from its "
+        "TransitionTable into the kernels (hpa2_tpu/protocols/); "
+        "'mesi' is the reference protocol and stays bit-identical to "
+        "the hand-written build.  moesi/mesif run on the spec and jax "
+        "backends",
+    )
+    p.add_argument(
+        "--directory-format", default="full", metavar="FMT",
+        help="directory sharer representation: 'full' (exact "
+        "bitvector, the reference), 'limited:K' (K pointers, "
+        "overflow -> broadcast), 'coarse:G' (G-node groups).  "
+        "Non-full formats run on the spec and jax backends",
     )
     p.add_argument(
         "--robust", action="store_true",

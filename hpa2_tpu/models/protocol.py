@@ -30,20 +30,35 @@ import enum
 
 
 class CacheState(enum.IntEnum):
-    """MESI cache-line states (assignment.c:17)."""
+    """Cache-line states.
+
+    The first four are the MESI states with reference enum values
+    (assignment.c:17).  The protocol-variant states append after them
+    so MESI-encoded arrays stay bit-identical: ``OWNED`` is MOESI's
+    dirty-shared responder, ``FORWARD`` is MESIF's clean designated
+    responder.
+    """
 
     MODIFIED = 0
     EXCLUSIVE = 1
     SHARED = 2
     INVALID = 3
+    OWNED = 4    # MOESI only: dirty, shared, answers reads
+    FORWARD = 5  # MESIF only: clean, shared, answers reads
 
 
 class DirState(enum.IntEnum):
-    """Directory entry states (assignment.c:18, README.md:20-23)."""
+    """Directory entry states (assignment.c:18, README.md:20-23).
+
+    ``SO`` appends after the reference values: MOESI's "shared with a
+    dirty owner" state, whose owner is tracked in the separate
+    dir-owner pointer plane.
+    """
 
     EM = 0  # exactly one cache holds the block (clean or dirty)
     S = 1   # one or more caches hold it shared
     U = 2   # no cache holds it
+    SO = 3  # MOESI only: shared, one OWNED cache holds the dirty copy
 
 
 class MsgType(enum.IntEnum):
@@ -105,8 +120,11 @@ class Message:
 
 
 #: REPLY_RD exclusivity flag values (assignment.c:201, 207, 245).
+#: REPLY_RD_FORWARD is the MESIF extension: fill the line in FORWARD
+#: state (clean designated responder); never emitted by MESI/MOESI.
 REPLY_RD_EXCLUSIVE = 2
 REPLY_RD_SHARED = 0
+REPLY_RD_FORWARD = 1
 
 
 @dataclasses.dataclass(frozen=True)

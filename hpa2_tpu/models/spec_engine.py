@@ -53,13 +53,14 @@ from hpa2_tpu.models.protocol import (
     Message,
     MsgType,
     NO_PROC,
-    REPLY_RD_EXCLUSIVE,
     REPLY_RD_SHARED,
     bit,
     count_sharers,
     find_owner,
     is_bit_set,
 )
+from hpa2_tpu.protocols.compiler import generated_dispatch, planes_for
+from hpa2_tpu.protocols.directory import dir_mask_int, parse_format
 from hpa2_tpu.utils.dump import NodeDump
 from hpa2_tpu.utils.trace import IssueRecord, TraceRing
 
@@ -75,6 +76,10 @@ class CacheLine:
 class DirEntry:
     state: DirState = DirState.U
     sharers: int = 0
+    # tracked owner/forwarder node (NO_PROC = none).  MOESI: the OWNED
+    # cache while state == SO; MESIF: the FORWARD cache while state ==
+    # S.  MESI never writes it.
+    owner: int = NO_PROC
 
 
 class Node:
@@ -83,6 +88,12 @@ class Node:
     def __init__(self, node_id: int, config: SystemConfig, trace: Sequence[Instr]):
         self.id = node_id
         self.config = config
+        # owner-plane protocols carry dir_owner in their dumps; MESI
+        # keeps NodeDump.dir_owner = None so parity comparisons against
+        # native/fixture dumps stay field-for-field exact
+        self._dump_owner = planes_for(
+            config.protocol, config.semantics
+        ).has_owner_plane
         # memory init: 20 * id + i, byte-wrapped (assignment.c:779)
         self.memory: List[int] = [
             (20 * node_id + i) % 256 for i in range(config.mem_size)
@@ -130,6 +141,10 @@ class Node:
             cache_addr=[l.address for l in self.cache],
             cache_value=[l.value for l in self.cache],
             cache_state=[l.state for l in self.cache],
+            dir_owner=(
+                [d.owner for d in self.directory]
+                if self._dump_owner else None
+            ),
         )
 
 
@@ -205,6 +220,15 @@ class SpecEngine:
             raise ValueError("need one trace per node")
         self.config = config
         self.sem: Semantics = config.semantics
+        # the compiled protocol: every state-set guard and reply kind
+        # below reads these planes, never a hand-written constant that
+        # differs between protocols
+        self.planes = planes_for(config.protocol, config.semantics)
+        self._dir_kind, self._dir_param = parse_format(
+            config.directory_format, config.num_procs
+        )
+        self._rd_fill = dict(self.planes.reply_rd_fill)
+        self._notify_map = dict(self.planes.notify_pairs)
         self.nodes = [Node(i, config, t) for i, t in enumerate(traces)]
         self.replay_order = list(replay_order) if replay_order is not None else None
         # "batched" replay lets consecutive order records issue in the
@@ -384,13 +408,7 @@ class SpecEngine:
             return
         home = self.config.home_of(line.address)
         self.counters["evictions"] += 1
-        if line.state in (CacheState.EXCLUSIVE, CacheState.SHARED):
-            self._send(
-                phase,
-                home,
-                Message(MsgType.EVICT_SHARED, node.id, line.address),
-            )
-        elif line.state == CacheState.MODIFIED:
+        if int(line.state) in self.planes.dirty_evict_states:
             self._send(
                 phase,
                 home,
@@ -398,6 +416,32 @@ class SpecEngine:
                     MsgType.EVICT_MODIFIED, node.id, line.address, value=line.value
                 ),
             )
+        else:
+            self._send(
+                phase,
+                home,
+                Message(MsgType.EVICT_SHARED, node.id, line.address),
+            )
+
+    # -- owner-plane / directory-format helpers -----------------------
+
+    def _set_owner(self, dir_entry: DirEntry, new: int) -> None:
+        """Update the tracked owner/forwarder, counting migrations
+        (clearing to NO_PROC is a release, not a transfer)."""
+        if new >= 0 and new != dir_entry.owner:
+            self.counters["owner_transfers"] += 1
+        dir_entry.owner = new
+
+    def _fanout_mask(self, sharers: int, requester: int) -> int:
+        """The REPLY_ID invalidation fan-out through the configured
+        directory format (the one place format precision matters)."""
+        mask, overflowed = dir_mask_int(
+            self._dir_kind, self._dir_param, sharers, requester,
+            self.config.num_procs,
+        )
+        if overflowed:
+            self.counters["dir_overflows"] += 1
+        return mask
 
     # -- protocol handler (assignment.c:187-566) ----------------------
     #
@@ -439,6 +483,7 @@ class SpecEngine:
 
     def _on_read_request(self, node, msg, home, blk, line, dir_entry):
         PH = 0
+        P = self.planes
         assert dir_entry is not None, "READ_REQUEST must arrive at home"
         reply = Message(
             MsgType.REPLY_RD, node.id, msg.address,
@@ -447,19 +492,56 @@ class SpecEngine:
         if dir_entry.state == DirState.U:
             dir_entry.state = DirState.EM
             dir_entry.sharers = bit(msg.sender)
-            reply.sharers = REPLY_RD_EXCLUSIVE
+            reply.sharers = P.rr_u_flag
             self._send(PH, msg.sender, reply)
         elif dir_entry.state == DirState.S:
-            dir_entry.sharers |= bit(msg.sender)
-            reply.sharers = REPLY_RD_SHARED
-            self._send(PH, msg.sender, reply)
+            fwd = dir_entry.owner if P.has_fwd else NO_PROC
+            if fwd >= 0 and fwd != msg.sender:
+                # live forwarder serves cache-to-cache; the newest
+                # reader becomes the forwarder
+                self._send(
+                    PH, fwd,
+                    Message(
+                        MsgType.WRITEBACK_INT, node.id, msg.address,
+                        second_receiver=msg.sender,
+                    ),
+                )
+                dir_entry.sharers |= bit(msg.sender)
+                self._set_owner(dir_entry, msg.sender)
+            else:
+                dir_entry.sharers |= bit(msg.sender)
+                reply.sharers = P.rr_s_flag
+                self._send(PH, msg.sender, reply)
+                if P.has_fwd and fwd != msg.sender:
+                    # no live forwarder: the reader seeds F
+                    self._set_owner(dir_entry, msg.sender)
+        elif P.has_so and dir_entry.state == DirState.SO:
+            owner = dir_entry.owner
+            if owner == msg.sender:
+                # owner lost its line (eviction in flight): demote to
+                # clean-shared and serve from memory
+                dir_entry.state = DirState.S
+                self._set_owner(dir_entry, NO_PROC)
+                dir_entry.sharers |= bit(msg.sender)
+                reply.sharers = P.rr_s_flag
+                self._send(PH, msg.sender, reply)
+            else:
+                # the owner answers every read cache-to-cache while SO
+                self._send(
+                    PH, owner,
+                    Message(
+                        MsgType.WRITEBACK_INT, node.id, msg.address,
+                        second_receiver=msg.sender,
+                    ),
+                )
+                dir_entry.sharers |= bit(msg.sender)
         else:  # EM
             owner = find_owner(dir_entry.sharers)
             assert owner != -1
             if owner == msg.sender:
                 # owner re-requesting (its copy was evicted-silently
                 # or lost): serve data, keep EM (assignment.c:215-221)
-                reply.sharers = REPLY_RD_EXCLUSIVE
+                reply.sharers = P.rr_u_flag
                 self._send(PH, msg.sender, reply)
             else:
                 self._send(
@@ -469,8 +551,16 @@ class SpecEngine:
                         second_receiver=msg.sender,
                     ),
                 )
-                # optimistic pre-flush transition (assignment.c:230-231)
-                dir_entry.state = DirState.S
+                if P.has_so:
+                    # the owner keeps its dirty line as OWNED
+                    dir_entry.state = DirState.SO
+                    self._set_owner(dir_entry, owner)
+                else:
+                    # optimistic pre-flush transition
+                    # (assignment.c:230-231)
+                    dir_entry.state = DirState.S
+                    if P.has_fwd:
+                        self._set_owner(dir_entry, msg.sender)
                 dir_entry.sharers |= bit(msg.sender)
 
     def _on_reply_rd(self, node, msg, home, blk, line, dir_entry):
@@ -483,27 +573,27 @@ class SpecEngine:
             self._replace(PH, node, line)
         line.address = msg.address
         line.value = msg.value
-        line.state = (
-            CacheState.EXCLUSIVE
-            if msg.sharers == REPLY_RD_EXCLUSIVE
-            else CacheState.SHARED
-        )
+        line.state = CacheState(self._rd_fill[msg.sharers])
         node.waiting = False
 
     def _on_writeback_int(self, node, msg, home, blk, line, dir_entry):
         PH = 0
-        if line.address == msg.address and line.state in (
-            CacheState.MODIFIED,
-            CacheState.EXCLUSIVE,
-        ):
+        P = self.planes
+        if line.address == msg.address and int(line.state) in P.wbint_resp_states:
             flush = Message(
                 MsgType.FLUSH, node.id, msg.address,
                 value=line.value, second_receiver=msg.second_receiver,
             )
-            self._send(PH, home, flush)
-            if msg.second_receiver != home:
-                self._send(PH, msg.second_receiver, flush.copy())
-            line.state = CacheState.SHARED
+            if int(line.state) in P.wbint_home_flush_states:
+                self._send(PH, home, flush)
+                if msg.second_receiver != home:
+                    self._send(PH, msg.second_receiver, flush.copy())
+            else:
+                # cache-to-cache fill without a home copy (MOESI OWNED
+                # keeps the dirty line; MESIF FORWARD is already clean)
+                self.counters["forwards"] += 1
+                self._send(PH, msg.second_receiver, flush)
+            line.state = CacheState(P.wbint_next_state)
         elif self.sem.intervention_miss_policy == "nack":
             self._send(
                 PH, home,
@@ -528,22 +618,27 @@ class SpecEngine:
                 self._replace(PH, node, line)
             line.address = msg.address
             line.value = msg.value
-            line.state = CacheState.SHARED
+            line.state = CacheState(self.planes.flush_fill_state)
             node.waiting = False
 
     def _on_upgrade(self, node, msg, home, blk, line, dir_entry):
         PH = 0
+        P = self.planes
         assert dir_entry is not None, "UPGRADE must arrive at home"
-        if dir_entry.state == DirState.S:
+        if dir_entry.state == DirState.S or (
+            P.has_so and dir_entry.state == DirState.SO
+        ):
             self._send(
                 PH, msg.sender,
                 Message(
                     MsgType.REPLY_ID, node.id, msg.address,
-                    sharers=dir_entry.sharers & ~bit(msg.sender),
+                    sharers=self._fanout_mask(dir_entry.sharers, msg.sender),
                 ),
             )
             dir_entry.state = DirState.EM
             dir_entry.sharers = bit(msg.sender)
+            if P.has_owner_plane:
+                self._set_owner(dir_entry, NO_PROC)
         else:
             # fallback: directory lost track (assignment.c:317-326)
             dir_entry.state = DirState.EM
@@ -574,15 +669,16 @@ class SpecEngine:
         node.waiting = False
 
     def _on_inv(self, node, msg, home, blk, line, dir_entry):
-        if line.address == msg.address and line.state in (
-            CacheState.SHARED,
-            CacheState.EXCLUSIVE,
+        if (
+            line.address == msg.address
+            and int(line.state) in self.planes.inv_states
         ):
             line.state = CacheState.INVALID
             self.counters["invalidations"] += 1
 
     def _on_write_request(self, node, msg, home, blk, line, dir_entry):
         PH = 0
+        P = self.planes
         assert dir_entry is not None, "WRITE_REQUEST must arrive at home"
         if self.sem.eager_write_request_memory:
             # HEAD quirk (assignment.c:379); fixtures update memory
@@ -595,16 +691,22 @@ class SpecEngine:
                 PH, msg.sender,
                 Message(MsgType.REPLY_WR, node.id, msg.address),
             )
-        elif dir_entry.state == DirState.S:
+        elif dir_entry.state == DirState.S or (
+            P.has_so and dir_entry.state == DirState.SO
+        ):
+            # the writer invalidates everyone, incl. any tracked
+            # owner/forwarder
             self._send(
                 PH, msg.sender,
                 Message(
                     MsgType.REPLY_ID, node.id, msg.address,
-                    sharers=dir_entry.sharers & ~bit(msg.sender),
+                    sharers=self._fanout_mask(dir_entry.sharers, msg.sender),
                 ),
             )
             dir_entry.state = DirState.EM
             dir_entry.sharers = bit(msg.sender)
+            if P.has_owner_plane:
+                self._set_owner(dir_entry, NO_PROC)
         else:  # EM
             owner = find_owner(dir_entry.sharers)
             assert owner != -1
@@ -638,9 +740,9 @@ class SpecEngine:
 
     def _on_writeback_inv(self, node, msg, home, blk, line, dir_entry):
         PH = 0
-        if line.address == msg.address and line.state in (
-            CacheState.MODIFIED,
-            CacheState.EXCLUSIVE,
+        if (
+            line.address == msg.address
+            and int(line.state) in self.planes.wbinv_resp_states
         ):
             ack = Message(
                 MsgType.FLUSH_INVACK, node.id, msg.address,
@@ -667,6 +769,8 @@ class SpecEngine:
             node.memory[blk] = msg.value
             dir_entry.state = DirState.EM
             dir_entry.sharers = bit(msg.second_receiver)
+            if self.planes.has_owner_plane:
+                self._set_owner(dir_entry, NO_PROC)
         if node.id == msg.second_receiver:
             assert (
                 line.address == msg.address
@@ -686,15 +790,22 @@ class SpecEngine:
 
     def _on_evict_shared(self, node, msg, home, blk, line, dir_entry):
         PH = 0
+        P = self.planes
         if node.id == home:
             assert dir_entry is not None
             if is_bit_set(dir_entry.sharers, msg.sender):
+                was_s = dir_entry.state == DirState.S
+                in_so = P.has_so and dir_entry.state == DirState.SO
                 dir_entry.sharers &= ~bit(msg.sender)
                 remaining = count_sharers(dir_entry.sharers)
                 if remaining == 0:
                     dir_entry.state = DirState.U
-                elif remaining == 1 and dir_entry.state == DirState.S:
+                    if in_so or (P.has_fwd and was_s):
+                        self._set_owner(dir_entry, NO_PROC)
+                elif remaining == 1 and (was_s or in_so):
                     dir_entry.state = DirState.EM
+                    if in_so or (P.has_fwd and was_s):
+                        self._set_owner(dir_entry, NO_PROC)
                     survivor = find_owner(dir_entry.sharers)
                     notify_type = (
                         MsgType.EVICT_SHARED
@@ -705,6 +816,14 @@ class SpecEngine:
                         PH, survivor,
                         Message(notify_type, node.id, msg.address),
                     )
+                elif (
+                    P.has_fwd and was_s
+                    and dir_entry.owner == msg.sender
+                ):
+                    # an evicting forwarder abdicates; the next reader
+                    # re-seeds F
+                    self._set_owner(dir_entry, NO_PROC)
+                # several_left in SO: sharers shrink, SO + owner stay
         elif self.sem.overloaded_evict_shared_notify:
             # HEAD's overloaded upgrade-notify (assignment.c:522-538)
             if msg.sender == home:
@@ -717,14 +836,17 @@ class SpecEngine:
         # semantics (the notify is UPGRADE_NOTIFY)
 
     def _on_upgrade_notify(self, node, msg, home, blk, line, dir_entry):
-        # home -> surviving sharer: your S copy is now E.  Distinct
-        # type fixes the home-is-a-sharer livelock (SURVEY.md §6.3);
-        # the home itself receives it through its own mailbox too.
-        if msg.sender == home:
-            if line.address == msg.address and line.state == CacheState.SHARED:
-                line.state = CacheState.EXCLUSIVE
+        # home -> surviving sharer: silent promotion (MESI/MESIF S->E,
+        # MOESI also O->M).  Distinct type fixes the home-is-a-sharer
+        # livelock (SURVEY.md §6.3); the home itself receives it
+        # through its own mailbox too.
+        if msg.sender == home and line.address == msg.address:
+            nxt = self._notify_map.get(int(line.state))
+            if nxt is not None:
+                line.state = CacheState(nxt)
 
     def _on_evict_modified(self, node, msg, home, blk, line, dir_entry):
+        P = self.planes
         assert dir_entry is not None, "EVICT_MODIFIED must arrive at home"
         node.memory[blk] = msg.value
         if dir_entry.state == DirState.EM and is_bit_set(
@@ -732,6 +854,20 @@ class SpecEngine:
         ):
             dir_entry.sharers = 0
             dir_entry.state = DirState.U
+        elif (
+            P.has_so
+            and dir_entry.state == DirState.SO
+            and dir_entry.owner == msg.sender
+        ):
+            # the OWNED cache wrote back: remaining sharers (if any)
+            # are clean-shared against the freshened memory
+            dir_entry.sharers &= ~bit(msg.sender)
+            self._set_owner(dir_entry, NO_PROC)
+            dir_entry.state = (
+                DirState.U
+                if count_sharers(dir_entry.sharers) == 0
+                else DirState.S
+            )
         # else: stale eviction — release-build HEAD leaves the
         # directory untouched (recovery exists only under DEBUG_MSG,
         # assignment.c:548-560)
@@ -741,21 +877,30 @@ class SpecEngine:
         # memory.  The stale owner no longer holds the line, so the
         # home can satisfy the requester directly.
         PH = 0
+        P = self.planes
         assert dir_entry is not None, "NACK must arrive at home"
         requester = msg.second_receiver
         if msg.sharers == 0:  # read
             dir_entry.state = DirState.S
             dir_entry.sharers |= bit(requester)
+            if P.has_fwd:
+                # the re-served reader becomes the forwarder
+                self._set_owner(dir_entry, requester)
+            elif P.has_so:
+                # owner tracking is stale by construction
+                self._set_owner(dir_entry, NO_PROC)
             self._send(
                 PH, requester,
                 Message(
                     MsgType.REPLY_RD, node.id, msg.address,
-                    value=node.memory[blk], sharers=REPLY_RD_SHARED,
+                    value=node.memory[blk], sharers=P.nack_rd_flag,
                 ),
             )
         else:  # write
             dir_entry.state = DirState.EM
             dir_entry.sharers = bit(requester)
+            if P.has_owner_plane:
+                self._set_owner(dir_entry, NO_PROC)
             self._send(
                 PH, requester,
                 Message(MsgType.REPLY_WR, node.id, msg.address),
@@ -774,12 +919,16 @@ class SpecEngine:
             )
         )
         PH = 1  # issue phase
+        P = self.planes
         cfg = self.config
         home = cfg.home_of(instr.address)
         line = node.line_for(instr.address)
 
         if instr.op == "R":
-            if line.address == instr.address and line.state != CacheState.INVALID:
+            if (
+                line.address == instr.address
+                and int(line.state) in P.read_hit_states
+            ):
                 self.counters["read_hits"] += 1
             else:
                 self.counters["read_misses"] += 1
@@ -798,10 +947,10 @@ class SpecEngine:
             node.pending_write = instr.value
             if line.address == instr.address and line.state != CacheState.INVALID:
                 self.counters["write_hits"] += 1
-                if line.state in (CacheState.MODIFIED, CacheState.EXCLUSIVE):
+                if int(line.state) in P.silent_write_states:
                     line.value = instr.value
                     line.state = CacheState.MODIFIED  # silent E->M upgrade
-                elif line.state == CacheState.SHARED:
+                elif int(line.state) in P.upgrade_write_states:
                     self._send(
                         PH, home,
                         Message(MsgType.UPGRADE, node.id, instr.address),
@@ -1072,3 +1221,12 @@ class SpecEngine:
     def final_dumps(self) -> List[NodeDump]:
         """Final quiescent state (a mode the reference lacks)."""
         return [n.dump() for n in self.nodes]
+
+
+# _DISPATCH stays a literal dict (the analyzer's dispatch lint pins
+# that), but it cannot drift from the table's event vocabulary: the
+# compiled-table derivation must agree with it exactly.
+assert SpecEngine._DISPATCH == generated_dispatch(), (
+    "SpecEngine._DISPATCH disagrees with the dispatch generated from "
+    "the transition table's event vocabulary"
+)

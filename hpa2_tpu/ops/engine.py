@@ -46,8 +46,8 @@ from hpa2_tpu.utils.dump import NodeDump
 from hpa2_tpu.utils.trace import IssueRecord
 
 
-def _node_dump_from(arrs, node_id: int) -> NodeDump:
-    mem, dstate, dsh, caddr, cval, cstate = arrs
+def _node_dump_from(arrs, node_id: int, with_owner: bool = False) -> NodeDump:
+    mem, dstate, dsh, down, caddr, cval, cstate = arrs
     return NodeDump(
         proc_id=node_id,
         memory=[int(x) for x in mem[node_id]],
@@ -56,7 +56,19 @@ def _node_dump_from(arrs, node_id: int) -> NodeDump:
         cache_addr=[int(x) for x in caddr[node_id]],
         cache_value=[int(x) for x in cval[node_id]],
         cache_state=[int(x) for x in cstate[node_id]],
+        dir_owner=(
+            [int(x) for x in down[node_id]] if with_owner else None
+        ),
     )
+
+
+def _owner_dumped(config: SystemConfig) -> bool:
+    """Owner-plane protocols carry dir_owner in their dumps; MESI keeps
+    NodeDump.dir_owner = None so parity fixtures compare unchanged
+    (mirrors the spec engine's gate)."""
+    from hpa2_tpu.protocols.compiler import planes_for
+
+    return planes_for(config.protocol, config.semantics).has_owner_plane
 
 
 class JaxEngine:
@@ -157,10 +169,13 @@ class JaxEngine:
             ]
             if capture:
                 arrs = self._live_arrays(st)
+                wo = _owner_dumped(self.config)
                 for i in capture:
                     if not completed[i]:
                         completed[i] = True
-                    self.dump_candidates[i].append(_node_dump_from(arrs, i))
+                    self.dump_candidates[i].append(
+                        _node_dump_from(arrs, i, wo)
+                    )
         self.state = st
         return self
 
@@ -171,7 +186,7 @@ class JaxEngine:
         return tuple(
             np.asarray(x)
             for x in (
-                st.mem, st.dir_state, st.dir_sharers,
+                st.mem, st.dir_state, st.dir_sharers, st.dir_owner,
                 st.cache_addr, st.cache_val, st.cache_state,
             )
         )
@@ -182,6 +197,7 @@ class JaxEngine:
             np.asarray(x)
             for x in (
                 st.snap_mem, st.snap_dir_state, st.snap_dir_sharers,
+                st.snap_dir_owner,
                 st.snap_cache_addr, st.snap_cache_val, st.snap_cache_state,
             )
         )
@@ -189,14 +205,18 @@ class JaxEngine:
     def snapshots(self) -> List[NodeDump]:
         """Canonical (earliest) dump-at-local-completion per node."""
         arrs = self._snap_arrays(self.state)
+        wo = _owner_dumped(self.config)
         return [
-            _node_dump_from(arrs, i) for i in range(self.config.num_procs)
+            _node_dump_from(arrs, i, wo)
+            for i in range(self.config.num_procs)
         ]
 
     def final_dumps(self) -> List[NodeDump]:
         arrs = self._live_arrays(self.state)
+        wo = _owner_dumped(self.config)
         return [
-            _node_dump_from(arrs, i) for i in range(self.config.num_procs)
+            _node_dump_from(arrs, i, wo)
+            for i in range(self.config.num_procs)
         ]
 
     @property
@@ -266,7 +286,8 @@ def stall_diagnostic(
                 f"0x{int(row[MB_ADDR]):02X}"
             )
     arrs = JaxEngine._live_arrays(st)
-    dumps = [_node_dump_from(arrs, i) for i in range(n)]
+    wo = _owner_dumped(config)
+    dumps = [_node_dump_from(arrs, i, wo) for i in range(n)]
     return StallDiagnostic(
         reason=reason,
         cycle=int(st.cycle),
@@ -327,6 +348,11 @@ def engine_stats(st: SimState) -> dict:
         # is unchanged wherever elision never fired
         ("elided_cycles", st.n_elided),
         ("multi_hit_retired", st.n_multi_hit),
+        # protocol-variant counters (ISSUE-13): MESI builds never
+        # touch them, so the reference schema stays exact
+        ("forwards", st.n_forwards),
+        ("owner_transfers", st.n_owner_xfer),
+        ("dir_overflows", st.n_dir_overflow),
     ):
         val = tot(field)
         if val:
@@ -849,15 +875,19 @@ class BatchJaxEngine:
     def system_snapshots(self, b: int) -> List[NodeDump]:
         st_b = jax.tree_util.tree_map(lambda x: np.asarray(x)[b], self.state)
         arrs = JaxEngine._snap_arrays(st_b)
+        wo = _owner_dumped(self.config)
         return [
-            _node_dump_from(arrs, i) for i in range(self.config.num_procs)
+            _node_dump_from(arrs, i, wo)
+            for i in range(self.config.num_procs)
         ]
 
     def system_final_dumps(self, b: int) -> List[NodeDump]:
         st_b = jax.tree_util.tree_map(lambda x: np.asarray(x)[b], self.state)
         arrs = JaxEngine._live_arrays(st_b)
+        wo = _owner_dumped(self.config)
         return [
-            _node_dump_from(arrs, i) for i in range(self.config.num_procs)
+            _node_dump_from(arrs, i, wo)
+            for i in range(self.config.num_procs)
         ]
 
     def stats(self) -> dict:
@@ -1028,8 +1058,9 @@ class BatchLaneSession:
 
     def dumps_of(self, row: SimState) -> List[NodeDump]:
         arrs = JaxEngine._live_arrays(row)
+        wo = _owner_dumped(self.config)
         return [
-            _node_dump_from(arrs, i)
+            _node_dump_from(arrs, i, wo)
             for i in range(self.config.num_procs)
         ]
 

@@ -101,22 +101,29 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from hpa2_tpu.config import SystemConfig
-from hpa2_tpu.models.protocol import CacheState, DirState, MsgType
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.models.protocol import MsgType
 from hpa2_tpu.models.spec_engine import StallError
 from hpa2_tpu.ops import exchange
+from hpa2_tpu.protocols.compiler import planes_for
 from hpa2_tpu.utils.dump import NodeDump
 
 I32 = jnp.int32
 U32 = jnp.uint32
 
-_M = int(CacheState.MODIFIED)
-_E = int(CacheState.EXCLUSIVE)
-_S = int(CacheState.SHARED)
-_I = int(CacheState.INVALID)
-_EM = int(DirState.EM)
-_DS = int(DirState.S)
-_DU = int(DirState.U)
+# The Mosaic kernel is specialized to the MESI/full-bitvector build
+# (PallasEngine gates on that below); its state constants come from
+# the compiled MESI table so the lowered planes stay the single
+# source of truth.  The state indices are semantics-invariant, so any
+# Semantics() works as the cache key here.
+_MESI_PLANES = planes_for("mesi", Semantics())
+_M = _MESI_PLANES.M
+_E = _MESI_PLANES.E
+_S = _MESI_PLANES.S
+_I = _MESI_PLANES.I
+_EM = _MESI_PLANES.EM
+_DS = _MESI_PLANES.DS
+_DU = _MESI_PLANES.DU
 
 _NO_MSG = -1
 _INVALID_ADDR = -1
@@ -247,6 +254,13 @@ def _mb_layout(config: SystemConfig):
 def _check_geometry(config: SystemConfig) -> None:
     if config.num_addresses >= (1 << 21):
         raise ValueError("pallas engine supports addresses < 2^21")
+    if config.protocol != "mesi" or config.directory_format != "full":
+        raise ValueError(
+            "the Pallas kernel is specialized to the MESI/full-bitvector "
+            "build; use the spec or XLA engines for "
+            f"protocol={config.protocol!r} "
+            f"directory_format={config.directory_format!r}"
+        )
 
 
 def _scalar_layout(config: SystemConfig, t_dim: int):

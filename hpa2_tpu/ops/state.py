@@ -86,6 +86,11 @@ class SimState(NamedTuple):
     mem: jnp.ndarray
     dir_state: jnp.ndarray
     dir_sharers: jnp.ndarray  # [N, M, W] uint32
+    # protocol-variant owner/forwarder pointer [N, M] (node id, -1 =
+    # none).  MOESI: the OWNED cache while dir_state == SO; MESIF: the
+    # FORWARD cache while dir_state == S.  Always present with uniform
+    # shape; MESI carries it untouched at -1.
+    dir_owner: jnp.ndarray
     # mailboxes: shift-down FIFO queues, head always at slot 0 (reads
     # are static slices; no gather — TPU scalarizes fused gathers).
     # One packed [N, cap, F] int32 array, columns = MB_* below
@@ -123,6 +128,7 @@ class SimState(NamedTuple):
     snap_mem: jnp.ndarray
     snap_dir_state: jnp.ndarray
     snap_dir_sharers: jnp.ndarray
+    snap_dir_owner: jnp.ndarray
     snap_cache_addr: jnp.ndarray
     snap_cache_val: jnp.ndarray
     snap_cache_state: jnp.ndarray
@@ -161,6 +167,10 @@ class SimState(NamedTuple):
     # =False and on engines that run lockstep (spec, pallas).
     n_elided: jnp.ndarray     # simulated cycles skipped by fast-forward
     n_multi_hit: jnp.ndarray  # instructions retired inside fast-forwards
+    # protocol-variant counters (ISSUE-13; scalars, zero under MESI/full)
+    n_forwards: jnp.ndarray      # cache-to-cache fills w/o a home copy
+    n_owner_xfer: jnp.ndarray    # owner/forwarder pointer migrations
+    n_dir_overflow: jnp.ndarray  # limited-pointer broadcast fallbacks
 
 
 def init_state_batched(
@@ -202,6 +212,7 @@ def init_state_batched(
         mem=jnp.asarray(mem0),
         dir_state=full((b, n, m), int(DirState.U), I32),
         dir_sharers=zeros((b, n, m, w), U32),
+        dir_owner=full((b, n, m), -1, I32),
         mb_data=jnp.broadcast_to(
             jnp.asarray(_mb_empty_row(w, topo_on)),
             (b, n, cap, 5 + w + topo_on),
@@ -228,6 +239,7 @@ def init_state_batched(
         snap_mem=jnp.asarray(mem0),
         snap_dir_state=full((b, n, m), int(DirState.U), I32),
         snap_dir_sharers=zeros((b, n, m, w), U32),
+        snap_dir_owner=full((b, n, m), -1, I32),
         snap_cache_addr=full((b, n, c), INVALID_ADDR, I32),
         snap_cache_val=zeros((b, n, c), I32),
         snap_cache_state=full((b, n, c), int(CacheState.INVALID), I32),
@@ -258,6 +270,9 @@ def init_state_batched(
         n_combined=zeros((b,), I32),
         n_elided=zeros((b,), I32),
         n_multi_hit=zeros((b,), I32),
+        n_forwards=zeros((b,), I32),
+        n_owner_xfer=zeros((b,), I32),
+        n_dir_overflow=zeros((b,), I32),
     )
 
 
@@ -312,6 +327,7 @@ def init_state(
         mem=jnp.asarray(mem0),
         dir_state=jnp.full((n, m), int(DirState.U), dtype=I32),
         dir_sharers=jnp.zeros((n, m, w), dtype=U32),
+        dir_owner=jnp.full((n, m), -1, dtype=I32),
         mb_data=jnp.broadcast_to(
             jnp.asarray(_mb_empty_row(w, topo_on)),
             (n, cap, 5 + w + topo_on),
@@ -338,6 +354,7 @@ def init_state(
         snap_mem=jnp.asarray(mem0),
         snap_dir_state=jnp.full((n, m), int(DirState.U), dtype=I32),
         snap_dir_sharers=jnp.zeros((n, m, w), dtype=U32),
+        snap_dir_owner=jnp.full((n, m), -1, dtype=I32),
         snap_cache_addr=jnp.full((n, c), INVALID_ADDR, dtype=I32),
         snap_cache_val=jnp.zeros((n, c), dtype=I32),
         snap_cache_state=jnp.full((n, c), int(CacheState.INVALID), dtype=I32),
@@ -366,4 +383,7 @@ def init_state(
         n_combined=jnp.zeros((), dtype=I32),
         n_elided=jnp.zeros((), dtype=I32),
         n_multi_hit=jnp.zeros((), dtype=I32),
+        n_forwards=jnp.zeros((), dtype=I32),
+        n_owner_xfer=jnp.zeros((), dtype=I32),
+        n_dir_overflow=jnp.zeros((), dtype=I32),
     )

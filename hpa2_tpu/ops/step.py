@@ -56,7 +56,7 @@ import jax
 import jax.numpy as jnp
 
 from hpa2_tpu.config import SystemConfig
-from hpa2_tpu.models.protocol import CacheState, DirState, MsgType
+from hpa2_tpu.models.protocol import MsgType
 from hpa2_tpu.ops import bits, exchange
 from hpa2_tpu.ops.state import (
     MB_ADDR,
@@ -67,19 +67,17 @@ from hpa2_tpu.ops.state import (
     MB_VALUE,
     SimState,
 )
+from hpa2_tpu.protocols.compiler import ProtocolPlanes, planes_for, state_in
+from hpa2_tpu.protocols.directory import group_mask_words, parse_format
 
 I32 = jnp.int32
 U32 = jnp.uint32
 
-# cache states
-_M = int(CacheState.MODIFIED)
-_E = int(CacheState.EXCLUSIVE)
-_S = int(CacheState.SHARED)
-_I = int(CacheState.INVALID)
-# dir states
-_EM = int(DirState.EM)
-_DS = int(DirState.S)
-_DU = int(DirState.U)
+# Every state constant below comes from the compiled ``ProtocolPlanes``
+# (hpa2_tpu.protocols.compiler) — the transition masks are lowered from
+# the declarative TransitionTable, never restated by hand.  The AST
+# lint (analysis/lint.py) pins this: no CacheState/DirState member
+# access in this module.
 
 _INVALID_ADDR = -1
 _NO_MSG = -1
@@ -147,12 +145,13 @@ class _SendSlots:
             self.second = jnp.where(mask, second, self.second)
 
 
-def _evict_msg(slots, mask, line_addr, line_val, line_state, mem_size):
+def _evict_msg(slots, mask, line_addr, line_val, line_state, mem_size, P):
     """handleCacheReplacement (assignment.c:742-773) as a masked send:
-    EVICT_SHARED for E/S victims, EVICT_MODIFIED (with value) for M."""
-    victim_valid = mask & (line_addr != _INVALID_ADDR) & (line_state != _I)
+    EVICT_SHARED for clean victims, EVICT_MODIFIED (with value) for the
+    protocol's dirty states (``P.dirty_evict_states``)."""
+    victim_valid = mask & (line_addr != _INVALID_ADDR) & (line_state != P.I)
     home = jnp.maximum(line_addr, 0) // mem_size
-    is_mod = line_state == _M
+    is_mod = state_in(line_state, P.dirty_evict_states, P.n_cache_states)
     slots.put(
         victim_valid,
         recv=home,
@@ -176,6 +175,7 @@ def build_step(
     replay: bool = False,
     axis_name: Optional[str] = None,
     shards: int = 1,
+    planes: Optional[ProtocolPlanes] = None,
 ):
     """Build the single-system step function (vmap for batches).
 
@@ -193,6 +193,20 @@ def build_step(
     w = config.sharer_words
     cap = config.msg_buffer_size
     sem = config.semantics
+    # the compiled protocol: every transition mask below is built from
+    # these planes (``planes`` overrides the config's table — the
+    # mutation fuzzer injects deliberately-broken planes this way)
+    P = planes if planes is not None else planes_for(config.protocol, sem)
+    NC = P.n_cache_states
+    _M, _S, _I = P.M, P.S, P.I
+    _EM, _DS, _DU, _SO = P.EM, P.DS, P.DU, P.SO
+    if len(P.reply_rd_fill) != 2:
+        raise ValueError(
+            f"the {P.protocol} table compiles to "
+            f"{len(P.reply_rd_fill)} REPLY_RD fill kinds; the kernel "
+            "lowering needs exactly two (a flag-selected pair)"
+        )
+    dir_kind, dir_param = parse_format(config.directory_format, n)
     if sem.overloaded_evict_shared_notify:
         raise ValueError(
             "the JAX backend implements fixture semantics only; the "
@@ -213,6 +227,12 @@ def build_step(
         if shards < 1 or n % shards != 0:
             raise ValueError(
                 f"num_procs={n} not divisible by shards={shards}"
+            )
+        if config.protocol != "mesi" or dir_kind != "full":
+            raise ValueError(
+                "node sharding runs the MESI/full-bitvector build "
+                "only; protocol and directory-format variants are "
+                "single-shard (shard the batch axis instead)"
             )
     nack = sem.intervention_miss_policy == "nack"
     fault = config.fault
@@ -251,6 +271,17 @@ def build_step(
         base_np = np.maximum(topo.base_lat[send_np], 1).astype(np.int32)
         n_links = paths_np.shape[2]
         mb_deliver = 5 + w  # deliver-at column (after sharer words)
+
+    # -- directory-format fan-out constants (static; the full format
+    # adds zero ops and keeps the exact MESI candidate tensors) --------
+    if dir_kind == "limited":
+        _all_int = (1 << n) - 1
+        all_words_np = np.array(
+            [(_all_int >> (32 * i)) & 0xFFFFFFFF for i in range(w)],
+            dtype=np.uint32,
+        )
+    elif dir_kind == "coarse":
+        gm_np = group_mask_words(dir_param, n, w, 32).view(np.uint32)
 
     def step(st: SimState) -> SimState:
         if axis_name is None:
@@ -307,11 +338,39 @@ def build_step(
         mem_blk = _gather_n(st.mem, blk)
         pw = st.pending_write
 
+        if P.has_owner_plane:
+            dow = _gather_n(st.dir_owner, blk)
+
         line_match = line_addr == a
-        line_me = (line_state == _M) | (line_state == _E)
         owner = bits.find_owner(dsh)
         owner_is_snd = owner == snd
         snd_bit = bits.bit_mask(snd, w)
+
+        def fanout(base):
+            """REPLY_ID fan-out through the directory-format lens:
+            (sharers minus requester) in, (mask, overflowed) out.  The
+            internal bitvector stays exact — precision is lost only
+            here, when the home composes an invalidation set."""
+            if dir_kind == "full":
+                return base, None
+            if dir_kind == "limited":
+                cnt = jnp.sum(
+                    jax.lax.population_count(base).astype(I32), axis=1
+                )
+                over = cnt > dir_param
+                allm = jnp.asarray(all_words_np)[None, :] & ~snd_bit
+                return jnp.where(over[:, None], allm, base), over
+            gm = jnp.asarray(gm_np)  # [G, W] disjoint group masks
+            hasg = jnp.any(
+                (base[:, None, :] & gm[None, :, :]) != 0, axis=2
+            )  # [N, G]
+            # disjoint masks: the summed words are an exact OR
+            spread = jnp.sum(
+                jnp.where(hasg[:, :, None], gm[None, :, :], U32(0)),
+                axis=1,
+                dtype=U32,
+            )
+            return spread & ~snd_bit, None
 
         sA0 = _SendSlots(n_local, w)
         sA1 = _SendSlots(n_local, w)
@@ -323,7 +382,12 @@ def build_step(
         nl_addr, nl_val, nl_state = line_addr, line_val, line_state
         upd_line = jnp.zeros((n_local,), dtype=bool)
         nd_state, nd_sharers = ds, dsh
+        if P.has_owner_plane:
+            nd_owner = dow
         upd_dir = jnp.zeros((n_local,), dtype=bool)
+        over_inc = (
+            jnp.zeros((), dtype=I32) if dir_kind == "limited" else None
+        )
         mem_write = jnp.zeros((n_local,), dtype=bool)
         mem_val = mem_blk
         waiting = st.waiting
@@ -334,9 +398,26 @@ def build_step(
         # --- READ_REQUEST (home only; assignment.c:188-236) ----------
         mk = typ(MsgType.READ_REQUEST) & is_home
         du, dss, dem = ds == _DU, ds == _DS, ds == _EM
-        reply_mask = mk & (du | dss | (dem & owner_is_snd))
         excl = du | (dem & owner_is_snd)
-        excl_flag = jnp.where(excl, U32(2), U32(0))
+        if P.has_so:
+            # MOESI: the tracked OWNED cache answers reads while SO
+            dso = ds == _SO
+            so_self = mk & dso & (dow == snd)  # owner lost its line
+            so_fwd = mk & dso & (dow != snd)
+            reply_mask = mk & (du | dss | (dem & owner_is_snd)) | so_self
+            fwd = (mk & dem & ~owner_is_snd) | so_fwd
+            fwd_to = jnp.where(so_fwd, dow, owner)
+        elif P.has_fwd:
+            # MESIF: a live forwarder serves dir-S reads cache-to-cache
+            live_f = dss & (dow >= 0) & (dow != snd)
+            reply_mask = mk & (du | (dss & ~live_f) | (dem & owner_is_snd))
+            fwd = mk & ((dem & ~owner_is_snd) | live_f)
+            fwd_to = jnp.where(mk & live_f, dow, owner)
+        else:
+            reply_mask = mk & (du | dss | (dem & owner_is_snd))
+            fwd = mk & dem & ~owner_is_snd
+            fwd_to = owner
+        excl_flag = jnp.where(excl, U32(P.rr_u_flag), U32(P.rr_s_flag))
         sA0.put(
             reply_mask,
             recv=snd,
@@ -345,47 +426,88 @@ def build_step(
             value=mem_blk,
             sharers=excl_flag[:, None] * jnp.eye(1, w, dtype=U32)[0][None, :],
         )
-        fwd = mk & dem & ~owner_is_snd
         sA0.put(
-            fwd, recv=owner, type_=int(MsgType.WRITEBACK_INT), addr=a,
+            fwd, recv=fwd_to, type_=int(MsgType.WRITEBACK_INT), addr=a,
             second=snd,
         )
         upd_dir = upd_dir | (mk & (du | dss | fwd))
         nd_state = jnp.where(mk & du, _EM, nd_state)
-        nd_state = jnp.where(fwd, _DS, nd_state)
+        if P.has_so:
+            # EM read-forward keeps the dirty owner: -> SO (a re-write
+            # of SO on the so_fwd part is a no-op); the abandoned-owner
+            # case demotes to clean-shared
+            upd_dir = upd_dir | so_self
+            nd_state = jnp.where(fwd, _SO, nd_state)
+            nd_state = jnp.where(so_self, _DS, nd_state)
+            nd_owner = jnp.where(mk & dem & ~owner_is_snd, owner, nd_owner)
+            nd_owner = jnp.where(so_self, -1, nd_owner)
+        else:
+            # optimistic pre-flush transition (assignment.c:230-231)
+            nd_state = jnp.where(fwd, _DS, nd_state)
+            if P.has_fwd:
+                # the newest reader becomes the forwarder
+                nd_owner = jnp.where(
+                    mk & ((dss & (dow != snd)) | (dem & ~owner_is_snd)),
+                    snd,
+                    nd_owner,
+                )
         nd_sharers = jnp.where(
             (mk & du)[:, None], snd_bit, nd_sharers
         )
+        share_join = mk & (dss | fwd)
+        if P.has_so:
+            share_join = share_join | so_self
         nd_sharers = jnp.where(
-            (mk & (dss | fwd))[:, None], nd_sharers | snd_bit, nd_sharers
+            share_join[:, None], nd_sharers | snd_bit, nd_sharers
         )
 
         # --- REPLY_RD (assignment.c:238-247) -------------------------
         mk = typ(MsgType.REPLY_RD)
         ev = mk & ~line_match
-        ev_replyrd = _evict_msg(sA0, ev, line_addr, line_val, line_state, m)
+        ev_replyrd = _evict_msg(
+            sA0, ev, line_addr, line_val, line_state, m, P
+        )
         upd_line = upd_line | mk
         nl_addr = jnp.where(mk, a, nl_addr)
         nl_val = jnp.where(mk, v, nl_val)
-        nl_state = jnp.where(mk, jnp.where(msh[:, 0] == 2, _E, _S), nl_state)
+        (rd_lo_flag, rd_lo_fill), (rd_hi_flag, rd_hi_fill) = P.reply_rd_fill
+        del rd_lo_flag  # the fill pair is flag-keyed; low is the default
+        nl_state = jnp.where(
+            mk,
+            jnp.where(msh[:, 0] == rd_hi_flag, rd_hi_fill, rd_lo_fill),
+            nl_state,
+        )
         waiting = jnp.where(mk, False, waiting)
 
         # --- WRITEBACK_INT (assignment.c:249-271) --------------------
         mk = typ(MsgType.WRITEBACK_INT)
-        ok = mk & line_match & line_me
+        resp = state_in(line_state, P.wbint_resp_states, NC)
+        ok = mk & line_match & resp
+        if P.fwd_count_states:
+            # cache-to-cache responders (MOESI OWNED keeps the dirty
+            # line; MESIF FORWARD is already clean): ONE flush to the
+            # requester, no home copy
+            c2c = ok & state_in(line_state, P.fwd_count_states, NC)
+            ok_home = ok & ~c2c
+            second_mask = (ok_home & (sr != home)) | c2c
+            fwd_inc = jnp.sum(c2c.astype(I32))
+        else:
+            ok_home = ok
+            second_mask = ok & (sr != home)
+            fwd_inc = None
         sA0.put(
-            ok, recv=home, type_=int(MsgType.FLUSH), addr=a, value=line_val,
-            second=sr,
+            ok_home, recv=home, type_=int(MsgType.FLUSH), addr=a,
+            value=line_val, second=sr,
         )
         sA1.put(
-            ok & (sr != home), recv=sr, type_=int(MsgType.FLUSH), addr=a,
+            second_mask, recv=sr, type_=int(MsgType.FLUSH), addr=a,
             value=line_val, second=sr,
         )
         upd_line = upd_line | ok
-        nl_state = jnp.where(ok, _S, nl_state)
+        nl_state = jnp.where(ok, P.wbint_next_state, nl_state)
         if nack:
             sA0.put(
-                mk & ~(line_match & line_me), recv=home,
+                mk & ~(line_match & resp), recv=home,
                 type_=int(MsgType.NACK), addr=a, second=sr,
             )
 
@@ -395,17 +517,24 @@ def build_step(
         mem_val = jnp.where(mk & is_home, v, mem_val)
         rq = mk & is_second
         ev = rq & ~line_match
-        ev_flush = _evict_msg(sA0, ev, line_addr, line_val, line_state, m)
+        ev_flush = _evict_msg(
+            sA0, ev, line_addr, line_val, line_state, m, P
+        )
         upd_line = upd_line | rq
         nl_addr = jnp.where(rq, a, nl_addr)
         nl_val = jnp.where(rq, v, nl_val)
-        nl_state = jnp.where(rq, _S, nl_state)
+        nl_state = jnp.where(rq, P.flush_fill_state, nl_state)
         waiting = jnp.where(rq, False, waiting)
 
         # --- UPGRADE (home only; assignment.c:298-328) ---------------
         mk = typ(MsgType.UPGRADE) & is_home
+        if P.has_so:
+            trk = (ds == _DS) | (ds == _SO)
+        else:
+            trk = ds == _DS
+        up_fan, up_over = fanout(dsh & ~snd_bit)
         reply_sh = jnp.where(
-            (mk & (ds == _DS))[:, None], dsh & ~snd_bit, jnp.zeros_like(dsh)
+            (mk & trk)[:, None], up_fan, jnp.zeros_like(dsh)
         )
         sA0.put(
             mk, recv=snd, type_=int(MsgType.REPLY_ID), addr=a,
@@ -414,6 +543,10 @@ def build_step(
         upd_dir = upd_dir | mk
         nd_state = jnp.where(mk, _EM, nd_state)
         nd_sharers = jnp.where(mk[:, None], snd_bit, nd_sharers)
+        if P.has_owner_plane:
+            nd_owner = jnp.where(mk & trk, -1, nd_owner)
+        if over_inc is not None:
+            over_inc = over_inc + jnp.sum((mk & trk & up_over).astype(I32))
 
         # --- REPLY_ID (assignment.c:330-364) -------------------------
         mk = typ(MsgType.REPLY_ID)
@@ -431,8 +564,8 @@ def build_step(
 
         # --- INV (assignment.c:366-373) ------------------------------
         mk = typ(MsgType.INV)
-        inv_applied = mk & line_match & (
-            (line_state == _S) | (line_state == _E)
+        inv_applied = mk & line_match & state_in(
+            line_state, P.inv_states, NC
         )
         upd_line = upd_line | inv_applied
         nl_state = jnp.where(inv_applied, _I, nl_state)
@@ -443,12 +576,16 @@ def build_step(
             mem_write = mem_write | mk
             mem_val = jnp.where(mk, v, mem_val)
         du, dss, dem = ds == _DU, ds == _DS, ds == _EM
+        if P.has_so:
+            # the writer invalidates everyone, incl. the tracked owner
+            dss = dss | (ds == _SO)
         wr_reply = mk & (du | (dem & owner_is_snd))
         sA0.put(wr_reply, recv=snd, type_=int(MsgType.REPLY_WR), addr=a)
         wr_id = mk & dss
+        wr_fan, wr_over = fanout(dsh & ~snd_bit)
         sA0.put(
             wr_id, recv=snd, type_=int(MsgType.REPLY_ID), addr=a,
-            sharers=dsh & ~snd_bit,
+            sharers=wr_fan,
         )
         wr_fwd = mk & dem & ~owner_is_snd
         sA0.put(
@@ -460,6 +597,10 @@ def build_step(
         nd_sharers = jnp.where(
             (mk & (du | dss | wr_fwd))[:, None], snd_bit, nd_sharers
         )
+        if P.has_owner_plane:
+            nd_owner = jnp.where(wr_id, -1, nd_owner)
+        if over_inc is not None:
+            over_inc = over_inc + jnp.sum((wr_id & wr_over).astype(I32))
 
         # --- REPLY_WR (assignment.c:437-449) -------------------------
         mk = typ(MsgType.REPLY_WR)
@@ -471,7 +612,8 @@ def build_step(
 
         # --- WRITEBACK_INV (assignment.c:451-473) --------------------
         mk = typ(MsgType.WRITEBACK_INV)
-        ok = mk & line_match & line_me
+        wbinv_resp = state_in(line_state, P.wbinv_resp_states, NC)
+        ok = mk & line_match & wbinv_resp
         sA0.put(
             ok, recv=home, type_=int(MsgType.FLUSH_INVACK), addr=a,
             value=line_val, second=sr,
@@ -484,7 +626,7 @@ def build_step(
         nl_state = jnp.where(ok, _I, nl_state)
         if nack:
             sA0.put(
-                mk & ~(line_match & line_me), recv=home,
+                mk & ~(line_match & wbinv_resp), recv=home,
                 type_=int(MsgType.NACK), addr=a,
                 sharers=jnp.ones((n_local, 1), dtype=U32)
                 * jnp.eye(1, w, dtype=U32)[0][None, :],
@@ -499,6 +641,8 @@ def build_step(
         upd_dir = upd_dir | hm
         nd_state = jnp.where(hm, _EM, nd_state)
         nd_sharers = jnp.where(hm[:, None], bits.bit_mask(sr, w), nd_sharers)
+        if P.has_owner_plane:
+            nd_owner = jnp.where(hm, -1, nd_owner)
         rq = mk & is_second
         upd_line = upd_line | rq
         nl_addr = jnp.where(rq, a, nl_addr)
@@ -514,18 +658,37 @@ def build_step(
         upd_dir = upd_dir | mk
         nd_sharers = jnp.where(mk[:, None], after, nd_sharers)
         nd_state = jnp.where(mk & (cnt == 0), _DU, nd_state)
-        upg = mk & (cnt == 1) & (ds == _DS)
+        if P.has_so:
+            es_trk = (ds == _DS) | (ds == _SO)
+        else:
+            es_trk = ds == _DS
+        upg = mk & (cnt == 1) & es_trk
         nd_state = jnp.where(upg, _EM, nd_state)
         survivor = bits.find_owner(after)
         sA0.put(
             upg, recv=survivor, type_=int(MsgType.UPGRADE_NOTIFY), addr=a,
         )
+        if P.has_so:
+            # SO loses owner tracking only when the set collapses;
+            # several-left keeps SO and the owner pointer
+            nd_owner = jnp.where(
+                mk & (ds == _SO) & (cnt <= 1), -1, nd_owner
+            )
+        elif P.has_fwd:
+            # an evicting forwarder abdicates; set-collapse clears too
+            nd_owner = jnp.where(
+                mk & (ds == _DS) & ((cnt <= 1) | (dow == snd)),
+                -1,
+                nd_owner,
+            )
 
         # --- UPGRADE_NOTIFY (fixture-semantics notify; spec_engine) --
         mk = typ(MsgType.UPGRADE_NOTIFY) & (snd == home)
-        hit = mk & line_match & (line_state == _S)
-        upd_line = upd_line | hit
-        nl_state = jnp.where(hit, _E, nl_state)
+        hit = mk & line_match
+        for _frm, _to in P.notify_pairs:
+            pm = hit & (line_state == _frm)
+            upd_line = upd_line | pm
+            nl_state = jnp.where(pm, _to, nl_state)
 
         # --- EVICT_MODIFIED (home only; assignment.c:541-561) --------
         mk = typ(MsgType.EVICT_MODIFIED) & is_home
@@ -537,6 +700,19 @@ def build_step(
         nd_sharers = jnp.where(
             drop[:, None], jnp.zeros_like(dsh), nd_sharers
         )
+        if P.has_so:
+            # the OWNED cache wrote back: remaining sharers (if any)
+            # are clean-shared against the freshened memory
+            somod = mk & (ds == _SO) & (dow == snd)
+            so_after = dsh & ~snd_bit
+            upd_dir = upd_dir | somod
+            nd_sharers = jnp.where(somod[:, None], so_after, nd_sharers)
+            nd_state = jnp.where(
+                somod,
+                jnp.where(bits.popcount(so_after) == 0, _DU, _DS),
+                nd_state,
+            )
+            nd_owner = jnp.where(somod, -1, nd_owner)
 
         # --- NACK (robust mode re-serve; spec_engine) ----------------
         if nack:
@@ -549,11 +725,37 @@ def build_step(
             nd_state = jnp.where(wr, _EM, nd_state)
             nd_sharers = jnp.where(rd[:, None], nd_sharers | sr_bit, nd_sharers)
             nd_sharers = jnp.where(wr[:, None], sr_bit, nd_sharers)
-            sA0.put(
-                rd, recv=sr, type_=int(MsgType.REPLY_RD), addr=a,
-                value=mem_blk,
-            )
+            if P.has_owner_plane:
+                if P.has_fwd:
+                    # the re-served reader becomes the forwarder
+                    nd_owner = jnp.where(rd, sr, nd_owner)
+                else:
+                    # owner tracking is stale by construction
+                    nd_owner = jnp.where(rd, -1, nd_owner)
+                nd_owner = jnp.where(wr, -1, nd_owner)
+            if P.nack_rd_flag:
+                sA0.put(
+                    rd, recv=sr, type_=int(MsgType.REPLY_RD), addr=a,
+                    value=mem_blk,
+                    sharers=jnp.full((n_local, 1), P.nack_rd_flag, U32)
+                    * jnp.eye(1, w, dtype=U32)[0][None, :],
+                )
+            else:
+                sA0.put(
+                    rd, recv=sr, type_=int(MsgType.REPLY_RD), addr=a,
+                    value=mem_blk,
+                )
             sA0.put(wr, recv=sr, type_=int(MsgType.REPLY_WR), addr=a)
+
+        # owner/forwarder pointer migrations this cycle (exact at one
+        # message per node: nd_owner diverges from dow only where a
+        # handler wrote it; clearing to -1 is a release, not counted)
+        if P.has_owner_plane:
+            xfer_inc = jnp.sum(
+                ((nd_owner != dow) & (nd_owner >= 0)).astype(I32)
+            )
+        else:
+            xfer_inc = None
 
         # scatter phase-A updates back into the SoA arrays
         ci_hot = jnp.arange(c, dtype=I32)[None, :] == ci[:, None]
@@ -568,6 +770,10 @@ def build_step(
         dir_sharers = jnp.where(
             dmask[:, :, None], nd_sharers[:, None, :], st.dir_sharers
         )
+        if P.has_owner_plane:
+            dir_owner = jnp.where(dmask, nd_owner[:, None], st.dir_owner)
+        else:
+            dir_owner = st.dir_owner
         mem = jnp.where(
             blk_hot & mem_write[:, None], mem_val[:, None], st.mem
         )
@@ -598,14 +804,16 @@ def build_step(
 
         rm = is_rd & ~hit
         wm = is_wr & ~hit
-        ev_issue = _evict_msg(sB0, rm | wm, l2_addr, l2_val, l2_state, m)
+        ev_issue = _evict_msg(
+            sB0, rm | wm, l2_addr, l2_val, l2_state, m, P
+        )
         sB1.put(rm, recv=home2, type_=int(MsgType.READ_REQUEST), addr=ia)
         sB1.put(
             wm, recv=home2, type_=int(MsgType.WRITE_REQUEST), addr=ia,
             value=iv,
         )
-        wh_me = is_wr & hit & ((l2_state == _M) | (l2_state == _E))
-        wh_s = is_wr & hit & (l2_state == _S)
+        wh_me = is_wr & hit & state_in(l2_state, P.silent_write_states, NC)
+        wh_s = is_wr & hit & state_in(l2_state, P.upgrade_write_states, NC)
         sB1.put(wh_s, recv=home2, type_=int(MsgType.UPGRADE), addr=ia)
 
         pending_write = jnp.where(is_wr, iv, st.pending_write)
@@ -1220,6 +1428,10 @@ def build_step(
         snap_mem = jnp.where(s2, mem, st.snap_mem)
         snap_dir_state = jnp.where(s2, dir_state, st.snap_dir_state)
         snap_dir_sharers = jnp.where(s3, dir_sharers, st.snap_dir_sharers)
+        if P.has_owner_plane:
+            snap_dir_owner = jnp.where(s2, dir_owner, st.snap_dir_owner)
+        else:
+            snap_dir_owner = st.snap_dir_owner
         snap_cache_addr = jnp.where(s2, cache_addr, st.snap_cache_addr)
         snap_cache_val = jnp.where(s2, cache_val, st.snap_cache_val)
         snap_cache_state = jnp.where(s2, cache_state, st.snap_cache_state)
@@ -1231,6 +1443,7 @@ def build_step(
             mem=mem,
             dir_state=dir_state,
             dir_sharers=dir_sharers,
+            dir_owner=dir_owner,
             mb_data=mb_data,
             mb_count=mb_count3,
             pc=pc,
@@ -1254,6 +1467,7 @@ def build_step(
             snap_mem=snap_mem,
             snap_dir_state=snap_dir_state,
             snap_dir_sharers=snap_dir_sharers,
+            snap_dir_owner=snap_dir_owner,
             snap_cache_addr=snap_cache_addr,
             snap_cache_val=snap_cache_val,
             snap_cache_state=snap_cache_state,
@@ -1282,6 +1496,18 @@ def build_step(
             n_combined=st.n_combined + comb_inc,
             n_elided=st.n_elided,
             n_multi_hit=st.n_multi_hit,
+            n_forwards=(
+                st.n_forwards if fwd_inc is None
+                else st.n_forwards + fwd_inc
+            ),
+            n_owner_xfer=(
+                st.n_owner_xfer if xfer_inc is None
+                else st.n_owner_xfer + xfer_inc
+            ),
+            n_dir_overflow=(
+                st.n_dir_overflow if over_inc is None
+                else st.n_dir_overflow + over_inc
+            ),
         )
 
     return step
@@ -1372,6 +1598,7 @@ def _hit_window(config: SystemConfig, st: SimState):
     predicate (state in {M, E}) of any later entry.
     """
     c = config.cache_size
+    P = planes_for(config.protocol, config.semantics)
     t = st.tr_op.shape[1]
     lw = min(_ELISION_WINDOW, t)
     karr = jnp.arange(lw, dtype=I32)
@@ -1387,7 +1614,11 @@ def _hit_window(config: SystemConfig, st: SimState):
     silent = (
         (pos < st.tr_len[:, None])
         & (tag == ia)
-        & jnp.where(is_w, (stt == _M) | (stt == _E), stt != _I)
+        & jnp.where(
+            is_w,
+            state_in(stt, P.silent_write_states, P.n_cache_states),
+            state_in(stt, P.read_hit_states, P.n_cache_states),
+        )
     )
     run_len = jnp.sum(jnp.cumprod(silent.astype(I32), axis=1), axis=1)
     return op, ia, iv, run_len
@@ -1467,6 +1698,7 @@ def build_fast_forward(config: SystemConfig):
     """
     fault_on = config.fault.enabled
     c = config.cache_size
+    P = planes_for(config.protocol, config.semantics)
 
     def fast_forward(st: SimState, j: jnp.ndarray) -> SimState:
         blocked = jnp.any(st.ob_valid, axis=1)  # all-false given j >= 1
@@ -1495,7 +1727,7 @@ def build_fast_forward(config: SystemConfig):
             axis=1,
         )
         cache_val = jnp.where(wrote, wval, st.cache_val)
-        cache_state = jnp.where(wrote, _M, st.cache_state)
+        cache_state = jnp.where(wrote, P.M, st.cache_state)
         # lockstep overwrites pending_write on EVERY write issue (hits
         # included): the jump leaves the last written value behind
         lastw = jnp.max(jnp.where(is_w, karr[None, :] + 1, 0), axis=1)

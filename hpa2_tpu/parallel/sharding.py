@@ -65,7 +65,10 @@ _NODE_LEADING = frozenset(
                  # scalar counters), never the node axis
                  "link_traversals", "link_max_load", "n_topo_delay",
                  "n_multicast_saved", "n_combined",
-                 "n_elided", "n_multi_hit")
+                 "n_elided", "n_multi_hit",
+                 # protocol-variant scalar counters (dir_owner and
+                 # snap_dir_owner ARE node-leading, so not listed)
+                 "n_forwards", "n_owner_xfer", "n_dir_overflow")
 )
 
 

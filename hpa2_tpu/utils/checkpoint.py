@@ -130,7 +130,7 @@ def _msg_from_list(row) -> "object":
 
 
 def _dump_to_dict(d) -> dict:
-    return {
+    out = {
         "proc_id": d.proc_id,
         "memory": list(d.memory),
         "dir_state": [int(s) for s in d.dir_state],
@@ -139,6 +139,9 @@ def _dump_to_dict(d) -> dict:
         "cache_value": list(d.cache_value),
         "cache_state": [int(s) for s in d.cache_state],
     }
+    if d.dir_owner is not None:  # owner-plane protocols only
+        out["dir_owner"] = list(d.dir_owner)
+    return out
 
 
 def _dump_from_dict(d) -> "object":
@@ -153,6 +156,10 @@ def _dump_from_dict(d) -> "object":
         cache_addr=list(d["cache_addr"]),
         cache_value=list(d["cache_value"]),
         cache_state=[CacheState(s) for s in d["cache_state"]],
+        dir_owner=(
+            list(d["dir_owner"]) if d.get("dir_owner") is not None
+            else None
+        ),
     )
 
 
@@ -194,7 +201,10 @@ def save_spec_state(path: str, engine) -> None:
         "nodes": [
             {
                 "memory": list(n.memory),
-                "dir": [[int(e.state), e.sharers] for e in n.directory],
+                # 3-element rows carry the tracked owner pointer; the
+                # loader accepts legacy 2-element (pre-protocol) rows
+                "dir": [[int(e.state), e.sharers, e.owner]
+                        for e in n.directory],
                 "cache": [[l.address, l.value, int(l.state)]
                           for l in n.cache],
                 "trace": [[i.op, i.address, i.value] for i in n.trace],
@@ -266,9 +276,11 @@ def load_spec_state(path: str):
         engine.link_tracker.load_state(doc["link_tracker"])
     for node, nd in zip(engine.nodes, doc["nodes"]):
         node.memory = list(nd["memory"])
-        for entry, (ds, sharers) in zip(node.directory, nd["dir"]):
-            entry.state = DirState(ds)
-            entry.sharers = sharers
+        for entry, row in zip(node.directory, nd["dir"]):
+            entry.state = DirState(row[0])
+            entry.sharers = row[1]
+            # pre-protocol checkpoints have 2-element rows (no owner)
+            entry.owner = row[2] if len(row) > 2 else -1
         for line, (addr, val, cs) in zip(node.cache, nd["cache"]):
             line.address = addr
             line.value = val

@@ -18,14 +18,16 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from hpa2_tpu.config import SystemConfig
 from hpa2_tpu.models.protocol import CacheState, DirState, INVALID_ADDR
 
-#: Render order matches the reference enums (assignment.c:826-828).
-_CACHE_STATE_STR = ["MODIFIED", "EXCLUSIVE", "SHARED", "INVALID"]
-_DIR_STATE_STR = ["EM", "S", "U"]
+#: Render order matches the reference enums (assignment.c:826-828);
+#: the protocol-variant states append after the frozen MESI values.
+_CACHE_STATE_STR = ["MODIFIED", "EXCLUSIVE", "SHARED", "INVALID",
+                    "OWNED", "FORWARD"]
+_DIR_STATE_STR = ["EM", "S", "U", "SO"]
 
 #: The reference's empty-line sentinel byte (assignment.c:785-787).
 _SENTINEL_BYTE = 0xFF
@@ -42,6 +44,10 @@ class NodeDump:
     cache_addr: List[int]                   # [cache_size] (INVALID_ADDR = empty)
     cache_value: List[int]                  # [cache_size]
     cache_state: List[CacheState]           # [cache_size]
+    # tracked owner/forwarder pointer per block (-1 = none); populated
+    # only by owner-plane protocols (MOESI/MESIF) so MESI dumps stay
+    # field-for-field identical to the reference format
+    dir_owner: Optional[List[int]] = None   # [mem_size]
 
 
 def _render_sharers(mask: int, width: int = 8) -> str:
@@ -125,9 +131,12 @@ def _format_wide(dump: NodeDump, config: SystemConfig) -> str:
         hexwords = ",".join(
             f"{(mask >> (32 * w)) & 0xFFFFFFFF:08x}" for w in range(words)
         )
+        owner = (
+            f" own={dump.dir_owner[i]}" if dump.dir_owner is not None else ""
+        )
         out.append(
             f"{i} {config.make_addr(pid, i):#x} "
-            f"{_DIR_STATE_STR[int(dump.dir_state[i])]} {hexwords}\n"
+            f"{_DIR_STATE_STR[int(dump.dir_state[i])]} {hexwords}{owner}\n"
         )
     out.append("[cache]\n")
     for i in range(config.cache_size):

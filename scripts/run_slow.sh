@@ -8,6 +8,9 @@
 #     counts, both split-plane widths)
 #   * the 64-node SW=3 split-plane differential (~5 min interpret
 #     mode; tests/test_pallas_engine.py)
+#   * the cross-protocol analyzer fuzz: seeded random table
+#     corruptions per protocol, each caught statically or by a
+#     backend probe diff (tests/test_protocol_fuzz.py)
 #
 # Run on demand (pre-release, after touching the native OMP engine or
 # the pallas sv_* helpers) — not part of the per-session gate.  Budget

@@ -186,6 +186,40 @@ then
   echo "TIER1: elision smoke failed" >&2
   exit 1
 fi
+# Protocol smoke (~20s, CPU): the ISSUE-13 compiled-table layer — the
+# lowered MESI planes must match their pinned digest byte-for-byte
+# (the reference protocol is frozen; tests/test_protocols.py carries
+# the same pin), and a tiny MOESI run must agree spec<->jax while
+# actually transferring ownership.  Catches lowering/wiring breaks
+# before the pytest budget is spent.
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python - > /dev/null <<'EOF'
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.models.spec_engine import SpecEngine
+from hpa2_tpu.ops.engine import JaxEngine
+from hpa2_tpu.protocols.compiler import planes_for
+from hpa2_tpu.utils.trace import gen_uniform_random
+
+assert planes_for("mesi", Semantics()).digest() == (
+    "10158e4dc973a48cec932b2cadc9c665"
+    "18770217695955ea8f099662396f27c0"
+), "compiled MESI planes drifted from the pinned digest"
+
+cfg = SystemConfig(num_procs=4, semantics=Semantics().robust(),
+                   protocol="moesi")
+traces = gen_uniform_random(cfg, 24, seed=13)
+jx = JaxEngine(cfg, traces).run()
+spec = SpecEngine(cfg, [list(t) for t in traces])
+spec.run()
+as_dicts = lambda dumps: [d.__dict__ for d in dumps]
+assert as_dicts(spec.final_dumps()) == as_dicts(jx.final_dumps())
+assert spec.cycle == jx.cycle
+assert spec.stats().get("owner_transfers", 0) > 0
+assert jx.stats()["owner_transfers"] == spec.stats()["owner_transfers"]
+EOF
+then
+  echo "TIER1: protocol smoke failed" >&2
+  exit 1
+fi
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
