@@ -625,35 +625,70 @@ def cmd_bench(args) -> int:
 
 def cmd_serve(args) -> int:
     config = _build_config(args)
-    if args.node_shards > 1:
-        raise SystemExit(
-            "serve shards the ensemble axis only (--data-shards); "
-            "node sharding is a run/bench feature"
-        )
     backend = args.backend
-    if backend == "pallas" and args.data_shards > 1:
+    if args.node_shards > 1:
+        if backend != "pallas":
+            raise SystemExit(
+                "--node-shards serving runs on the pallas backend "
+                "(the resident NodeShardedLaneSession; the jax rows "
+                "are single-shard)"
+            )
+        if args.nodes % args.node_shards != 0:
+            raise SystemExit(
+                f"--node-shards {args.node_shards} must divide "
+                f"--nodes {args.nodes} (shards own contiguous equal "
+                "node blocks)"
+            )
+        backend = "pallas-node-sharded"
+    elif backend == "pallas" and args.data_shards > 1:
         backend = "pallas-sharded"
     if (args.jobs is None) == (args.listen is None):
         raise SystemExit(
             "serve needs exactly one job feed: a JOBS.jsonl path or "
             "--listen HOST:PORT"
         )
+    if args.wire and not args.listen:
+        raise SystemExit(
+            "--wire frames the TCP feed; it needs --listen HOST:PORT"
+        )
+    from hpa2_tpu.service import TenantTable
     from hpa2_tpu.serving import FileJobSource, SocketJobSource, serve
 
+    try:
+        tenants = TenantTable.parse(args.tenant_weights or "")
+    except ValueError as e:
+        raise SystemExit(f"--tenant-weights: {e}")
+
+    wire_source = None
     if args.listen:
         host, _, port = args.listen.rpartition(":")
         try:
-            source = SocketJobSource(
-                config, host or "127.0.0.1", int(port)
-            )
+            port_n = int(port)
         except ValueError:
             raise SystemExit("--listen takes HOST:PORT")
-        print(
-            f"[serve] listening on "
-            f"{source.address[0]}:{source.address[1]} "
-            "(JSONL job records; {\"eof\": true} ends the feed)",
-            file=sys.stderr,
-        )
+        if args.wire:
+            from hpa2_tpu.service import WireJobSource
+
+            source = wire_source = WireJobSource(
+                config, host or "127.0.0.1", port_n,
+                credits=args.credits, tenants=tenants,
+            )
+            print(
+                f"[serve] framed wire on "
+                f"{source.address[0]}:{source.address[1]} "
+                f"({args.credits} admission credits per connection)",
+                file=sys.stderr,
+            )
+        else:
+            source = SocketJobSource(
+                config, host or "127.0.0.1", port_n
+            )
+            print(
+                f"[serve] listening on "
+                f"{source.address[0]}:{source.address[1]} "
+                "(JSONL job records; {\"eof\": true} ends the feed)",
+                file=sys.stderr,
+            )
     else:
         source = FileJobSource(
             config, args.jobs, timed=not args.immediate
@@ -673,6 +708,8 @@ def cmd_serve(args) -> int:
         if results_fh:
             results_fh.write(json.dumps(res.to_record()) + "\n")
             results_fh.flush()
+        if wire_source is not None:
+            wire_source.deliver(res)
 
     try:
         _, stats = serve(
@@ -683,12 +720,14 @@ def cmd_serve(args) -> int:
             block=args.block,
             policy=args.policy,
             data_shards=args.data_shards,
+            node_shards=args.node_shards,
             overlap=not args.no_overlap,
             interval=args.interval,
             max_trace_len=args.max_instr,
             max_cycles=args.max_cycles,
             decode_dumps=bool(out),
             emit=emit,
+            tenant_weights=tenants.weights or None,
         )
     finally:
         source.close()
@@ -931,10 +970,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         "a {\"eof\": true} record ends the feed",
     )
     sp.add_argument(
+        "--wire", action="store_true",
+        help="with --listen: speak the framed wire protocol "
+        "(hpa2_tpu/service/) instead of raw JSONL — every SUBMIT is "
+        "ACK'd with its admission seq or NACK'd with a reason, "
+        "results stream back to the submitting connection, and "
+        "overload pushes back via admission credits",
+    )
+    sp.add_argument(
+        "--credits", type=int, default=64,
+        help="--wire: admission credits per connection (how far a "
+        "client may run ahead of the scheduler before drawing NACKs)",
+    )
+    sp.add_argument(
+        "--tenant-weights", default=None, metavar="NAME:W,...",
+        help="fair-share weights for --policy fair-drr (e.g. "
+        "'alice:4,bob:1'; unlisted tenants weigh 1.0)",
+    )
+    sp.add_argument(
         "--backend", choices=("pallas", "jax"), default="pallas",
         help="pallas = resident-lane fast path (--data-shards > 1 "
-        "shards lanes over the device mesh); jax = XLA batch rows "
-        "(the backend with fault injection)",
+        "shards lanes over the device mesh, --node-shards > 1 splits "
+        "each system's node axis — jobs bigger than a chip); jax = "
+        "XLA batch rows (the backend with fault injection)",
     )
     sp.add_argument(
         "--resident", type=int, default=16,
@@ -953,8 +1011,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="jax backend: cycles per chunk between completion checks",
     )
     sp.add_argument(
-        "--policy", choices=("fcfs", "longest-first"), default="fcfs",
-        help="admission queue order at segment barriers",
+        "--policy", default="fcfs",
+        choices=("fcfs", "longest-first", "deadline-edf", "fair-drr"),
+        help="admission queue order at segment barriers: fcfs, "
+        "longest-first, deadline-edf (earliest absolute deadline "
+        "first), fair-drr (per-tenant weighted deficit round robin; "
+        "see --tenant-weights)",
     )
     sp.add_argument(
         "--immediate", action="store_true",

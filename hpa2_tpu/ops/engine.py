@@ -656,6 +656,34 @@ class BatchJaxEngine:
             raise self._batch_stall(vq)
         return self
 
+    def _group_order(
+        self, tr_len: np.ndarray, g: int, gs: int
+    ) -> np.ndarray:
+        """Group-local admission order honoring the Schedule's
+        multi-tenant metadata.  fair-drr charges one wave slot per
+        system (keys of one), keeping the wave plan in the same order
+        as the ones-cost occupancy replay that models it."""
+        from hpa2_tpu.ops.schedule import policy_order
+
+        sl = slice(g * gs, (g + 1) * gs)
+        sc = self.schedule
+        keys = (
+            np.ones(gs, dtype=np.int64) if sc.policy == "fair-drr"
+            else tr_len[sl]
+        )
+        return policy_order(
+            keys, sc.policy,
+            deadline=(
+                None if sc.deadlines is None
+                else np.asarray(sc.deadlines[sl], dtype=np.int64)
+            ),
+            tenant=(
+                None if sc.tenants is None
+                else np.asarray(sc.tenants[sl], dtype=np.int64)
+            ),
+            weights=sc.tenant_weights,
+        )
+
     def _run_scheduled_fused(self) -> "BatchJaxEngine":
         """The fused scheduled run: ONE device program consumes a
         precomputed wave plan (rows independent -> run each wave of
@@ -671,17 +699,13 @@ class BatchJaxEngine:
         # wave plan: group g's rows sweep its system slice gl at a time
         # in admission-policy order — exactly the admission order of
         # the PR-5 host-loop queues (row order within group, group-local)
-        from hpa2_tpu.ops.schedule import policy_order
-
         tr_len = np.array([
             max((len(t) for t in self._batch_traces[s]), default=0)
             for s in range(b)
         ], dtype=np.int64)
         wave_sys = np.full((n_waves, r), -1, dtype=np.int64)
         for g in range(groups):
-            order = g * gs + policy_order(
-                tr_len[g * gs:(g + 1) * gs], self.schedule.policy
-            )
+            order = g * gs + self._group_order(tr_len, g, gs)
             for k in range(n_waves):
                 chunk_s = order[k * gl:(k + 1) * gl]
                 wave_sys[k, g * gl:g * gl + len(chunk_s)] = chunk_s
@@ -740,7 +764,10 @@ class BatchJaxEngine:
         self.occupancy = simulate(
             np.ones(b, dtype=np.int64), resident=r, block=1,
             groups=groups, threshold=self.schedule.threshold,
-            fused=True,
+            fused=True, policy=self.schedule.policy,
+            deadline=self.schedule.deadlines,
+            tenant=self.schedule.tenants,
+            tenant_weights=self.schedule.tenant_weights,
         ).attach_elision(st)
         return self
 
@@ -771,8 +798,6 @@ class BatchJaxEngine:
         # contiguous group partition, mirroring the Pallas scheduler:
         # each data shard owns a contiguous slice of rows and systems
         # and never exchanges work with its neighbors
-        from hpa2_tpu.ops.schedule import policy_order
-
         tr_len = np.array([
             max((len(t) for t in self._batch_traces[s]), default=0)
             for s in range(self.b)
@@ -782,9 +807,7 @@ class BatchJaxEngine:
         row_sys = np.full(r, -1, dtype=np.int64)
         queues = []
         for g in range(groups):
-            order = g * gs + policy_order(
-                tr_len[g * gs:(g + 1) * gs], self.schedule.policy
-            )
+            order = g * gs + self._group_order(tr_len, g, gs)
             row_sys[g * gl:(g + 1) * gl] = order[:gl]
             queues.append(deque(int(s) for s in order[gl:]))
         st = place(stack_states([fresh(s) for s in row_sys]))

@@ -2764,6 +2764,9 @@ class PallasEngine:
             groups=self._sched_groups,
             threshold=self.schedule.threshold,
             policy=self.schedule.policy,
+            deadline=self.schedule.deadlines,
+            tenant=self.schedule.tenants,
+            tenant_weights=self.schedule.tenant_weights,
         )
         runner = self._fused_runner(max_cycles)
         state = {
@@ -2793,6 +2796,9 @@ class PallasEngine:
             groups=self._sched_groups,
             threshold=self.schedule.threshold,
             policy=self.schedule.policy,
+            deadline=self.schedule.deadlines,
+            tenant=self.schedule.tenants,
+            tenant_weights=self.schedule.tenant_weights,
         )
         runner = self._interval_runner(max_cycles)
         fields = list(self.state.keys())

@@ -37,7 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 from collections import deque
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -61,7 +61,18 @@ class Schedule:
     admission order within each group's queue — ``"fcfs"`` admits in
     ensemble order, ``"longest-first"`` admits systems with the most
     remaining segments first, which packs stragglers early so the tail
-    of the run is short traces draining together.
+    of the run is short traces draining together, ``"deadline-edf"``
+    admits earliest-absolute-deadline first (deadline-less systems
+    last, arrival order among ties), and ``"fair-drr"`` interleaves
+    tenants by weighted deficit round robin (deficit in segments, so a
+    heavy job charges its tenant proportionally).
+
+    The multi-tenant metadata rides as optional hashable tuples so the
+    knob stays usable as a cache key: ``deadlines[s]`` is system s's
+    completion deadline in scheduling intervals (-1 = none),
+    ``tenants[s]`` its integer tenant id, and ``tenant_weights[t]``
+    tenant t's DRR weight (indexed by tenant id; omitted tenants weigh
+    1.0).
     """
 
     resident: Optional[int] = None
@@ -69,19 +80,87 @@ class Schedule:
     interval: int = 256
     fused: bool = True
     policy: str = "fcfs"
+    deadlines: Optional[Tuple[int, ...]] = None
+    tenants: Optional[Tuple[int, ...]] = None
+    tenant_weights: Optional[Tuple[float, ...]] = None
 
 
 #: Admission-queue orderings understood by :class:`LaneScheduler`.
-POLICIES = ("fcfs", "longest-first")
+POLICIES = ("fcfs", "longest-first", "deadline-edf", "fair-drr")
+
+#: Per-tenant DRR weights: a dict keyed by tenant id, or a sequence
+#: indexed by tenant id.  Missing tenants weigh 1.0.
+TenantWeights = Union[Dict[int, float], Sequence[float], None]
 
 
-def policy_order(keys: np.ndarray, policy: str) -> np.ndarray:
+def _weight_of(weights: TenantWeights, tenant: int) -> float:
+    if weights is None:
+        return 1.0
+    if isinstance(weights, dict):
+        w = float(weights.get(tenant, 1.0))
+    elif 0 <= tenant < len(weights):
+        w = float(weights[tenant])
+    else:
+        w = 1.0
+    if w <= 0:
+        raise ValueError(
+            f"tenant {tenant} has non-positive DRR weight {w}"
+        )
+    return w
+
+
+def _drr_order(
+    keys: np.ndarray, tenant: np.ndarray, weights: TenantWeights
+) -> np.ndarray:
+    """Deterministic weighted deficit-round-robin total order: tenants
+    take turns in sorted-id order, each turn banking ``weight`` segments
+    of deficit and releasing queued jobs (arrival order within a
+    tenant) while the bank covers the head job's segment cost.  An
+    emptied tenant forfeits its bank (classic DRR), so fairness is over
+    *backlogged* tenants only."""
+    cost = np.maximum(np.asarray(keys, dtype=np.float64), 1.0)
+    queues: Dict[int, deque] = {}
+    for i, t in enumerate(tenant):
+        queues.setdefault(int(t), deque()).append(i)
+    order = sorted(queues)
+    deficit = {t: 0.0 for t in order}
+    out: List[int] = []
+    remaining = len(cost)
+    while remaining:
+        for t in order:
+            q = queues[t]
+            if not q:
+                continue
+            deficit[t] += _weight_of(weights, t)
+            while q and deficit[t] >= cost[q[0]]:
+                i = q.popleft()
+                deficit[t] -= cost[i]
+                out.append(i)
+                remaining -= 1
+            if not q:
+                deficit[t] = 0.0
+    return np.asarray(out, dtype=np.int64)
+
+
+def policy_order(
+    keys: np.ndarray,
+    policy: str,
+    *,
+    deadline: Optional[np.ndarray] = None,
+    tenant: Optional[np.ndarray] = None,
+    weights: TenantWeights = None,
+) -> np.ndarray:
     """Indices of ``keys`` in the admission order ``policy`` dictates.
 
     ``keys`` are per-system segment counts.  ``fcfs`` preserves the
     given order; ``longest-first`` sorts by descending key, stably, so
     equal-length systems keep their arrival order and the replay stays
-    deterministic.
+    deterministic.  ``deadline-edf`` sorts by ascending ``deadline``
+    (absolute interval index; -1 = no deadline, ordered last), stably.
+    ``fair-drr`` runs the deterministic weighted deficit round robin
+    over ``tenant`` ids with ``keys`` as the per-job segment cost.
+    The metadata arrays are ignored by the policies that don't use
+    them, so existing two-argument callers are unchanged.
     """
     keys = np.asarray(keys)
     ids = np.arange(len(keys), dtype=np.int64)
@@ -89,6 +168,16 @@ def policy_order(keys: np.ndarray, policy: str) -> np.ndarray:
         return ids
     if policy == "longest-first":
         return ids[np.argsort(-keys, kind="stable")]
+    if policy == "deadline-edf":
+        if deadline is None:
+            return ids
+        dl = np.asarray(deadline, dtype=np.int64)
+        eff = np.where(dl < 0, np.iinfo(np.int64).max, dl)
+        return ids[np.argsort(eff, kind="stable")]
+    if policy == "fair-drr":
+        if tenant is None:
+            tenant = np.zeros(len(keys), dtype=np.int64)
+        return _drr_order(keys, np.asarray(tenant), weights)
     raise ValueError(f"unknown policy {policy!r}; expected one of {POLICIES}")
 
 
@@ -129,6 +218,15 @@ class OccupancyStats:
     #: schema is unchanged wherever elision never fired
     elided_cycles: int = 0
     multi_hit_retired: int = 0
+    #: multi-tenant service counters (ISSUE-14) — deadline outcomes at
+    #: harvest (absolute-interval deadlines only; -1 jobs count in
+    #: neither) and live-lane-intervals per tenant id.  Like the
+    #: elision counters, absent from ``as_dict`` unless the run carried
+    #: deadlines / nontrivial tenants, so legacy artifacts are byte-
+    #: identical
+    deadline_met: int = 0
+    deadline_missed: int = 0
+    tenant_live: Dict[int, int] = dataclasses.field(default_factory=dict)
 
     @property
     def mean_live_fraction(self) -> float:
@@ -175,6 +273,17 @@ class OccupancyStats:
             out["elided_cycles"] = self.elided_cycles
         if self.multi_hit_retired:
             out["multi_hit_retired"] = self.multi_hit_retired
+        if self.deadline_met or self.deadline_missed:
+            total = self.deadline_met + self.deadline_missed
+            out["deadline_met"] = self.deadline_met
+            out["deadline_missed"] = self.deadline_missed
+            out["deadline_hit_rate"] = round(self.deadline_met / total, 4)
+        if self.tenant_live:
+            total = sum(self.tenant_live.values())
+            out["tenant_share"] = {
+                int(t): round(v / total, 4) if total else 0.0
+                for t, v in sorted(self.tenant_live.items())
+            }
         return out
 
     def attach_elision(self, state) -> "OccupancyStats":
@@ -256,6 +365,9 @@ class LaneScheduler:
         groups: int = 1,
         threshold: float = 0.5,
         policy: str = "fcfs",
+        deadline: Optional[np.ndarray] = None,
+        tenant: Optional[np.ndarray] = None,
+        tenant_weights: TenantWeights = None,
         _serving: bool = False,
     ):
         nseg = np.asarray(nseg, dtype=np.int64)
@@ -289,6 +401,32 @@ class LaneScheduler:
         gl = r // groups
         self._gl = gl
         self._serving = _serving
+        #: absolute deadline in intervals (-1 = none); at construction
+        #: enqueue time is interval 0 so relative == absolute
+        if deadline is None:
+            self._deadline = np.full(b, -1, dtype=np.int64)
+        else:
+            self._deadline = np.asarray(deadline, dtype=np.int64).copy()
+            if self._deadline.shape != (b,):
+                raise ValueError(
+                    f"deadline must have shape ({b},), got "
+                    f"{self._deadline.shape}"
+                )
+        if tenant is None:
+            self._tenant = np.zeros(b, dtype=np.int64)
+        else:
+            self._tenant = np.asarray(tenant, dtype=np.int64).copy()
+            if self._tenant.shape != (b,):
+                raise ValueError(
+                    f"tenant must have shape ({b},), got "
+                    f"{self._tenant.shape}"
+                )
+        #: kept by reference: the serving loop grows its weight table
+        #: as tenants first appear, and order-time lookups must see it
+        self._tenant_weights = tenant_weights
+        self._track_tenants = bool(
+            (self._tenant != 0).any() or tenant_weights
+        )
         self.lane_sys = np.full(r, -1, dtype=np.int64)
         self.lane_seg = np.zeros(r, dtype=np.int64)
         self._queues: List[deque] = [deque() for _ in range(groups)]
@@ -302,13 +440,24 @@ class LaneScheduler:
             gs = b // groups  # systems per group
             for g in range(groups):
                 sys0 = g * gs
-                order = sys0 + policy_order(
-                    nseg[sys0:sys0 + gs], policy
+                order = self._order_ids(
+                    sys0 + np.arange(gs, dtype=np.int64)
                 )
                 fill = min(gl, gs)
                 self.lane_sys[g * gl:g * gl + fill] = order[:fill]
                 self._queues[g] = deque(int(s) for s in order[fill:])
         self._in_interval = False
+
+    def _order_ids(self, ids: np.ndarray) -> np.ndarray:
+        """System ids reordered by ``policy`` with their metadata."""
+        ids = np.asarray(ids, dtype=np.int64)
+        order = policy_order(
+            self.nseg[ids], self.policy,
+            deadline=self._deadline[ids],
+            tenant=self._tenant[ids],
+            weights=self._tenant_weights,
+        )
+        return ids[order]
 
     @classmethod
     def serving(
@@ -319,20 +468,29 @@ class LaneScheduler:
         groups: int = 1,
         threshold: float = 0.5,
         policy: str = "fcfs",
+        tenant_weights: TenantWeights = None,
     ) -> "LaneScheduler":
         """An initially-empty scheduler for the always-on serving loop:
         all admissions flow through :meth:`extend` + barrier plans."""
         return cls(
             np.zeros(0, dtype=np.int64), resident=resident, block=block,
             groups=groups, threshold=threshold, policy=policy,
-            _serving=True,
+            tenant_weights=tenant_weights, _serving=True,
         )
 
-    def extend(self, nseg_new: np.ndarray) -> np.ndarray:
+    def extend(
+        self,
+        nseg_new: np.ndarray,
+        *,
+        deadline: Optional[np.ndarray] = None,
+        tenant: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
         """Enqueue newly-arrived systems (serving mode): each joins the
         group with the shortest queue (ties to the lowest group), and
-        each group's queue is re-ordered by ``policy``.  Returns the new
-        system ids, in arrival order."""
+        each group's queue is re-ordered by ``policy``.  ``deadline``
+        is *relative* — intervals from now — and is converted to the
+        absolute interval index here.  Returns the new system ids, in
+        arrival order."""
         if not self._serving:
             raise RuntimeError("extend() only valid on a serving scheduler")
         nseg_new = np.asarray(nseg_new, dtype=np.int64)
@@ -340,11 +498,34 @@ class LaneScheduler:
             raise ValueError("nseg_new must be a non-empty 1-D array")
         if (nseg_new < 1).any():
             raise ValueError("every system needs >= 1 segment")
+        n = len(nseg_new)
         sys0 = self.b
-        new_ids = sys0 + np.arange(len(nseg_new), dtype=np.int64)
+        new_ids = sys0 + np.arange(n, dtype=np.int64)
         self.nseg = np.concatenate([self.nseg, nseg_new])
         self.b = len(self.nseg)
-        self._enq_at.extend([self.stats.intervals] * len(nseg_new))
+        now = self.stats.intervals
+        if deadline is None:
+            dl_abs = np.full(n, -1, dtype=np.int64)
+        else:
+            dl = np.asarray(deadline, dtype=np.int64)
+            if dl.shape != (n,):
+                raise ValueError(
+                    f"deadline must have shape ({n},), got {dl.shape}"
+                )
+            dl_abs = np.where(dl >= 0, now + dl, -1)
+        self._deadline = np.concatenate([self._deadline, dl_abs])
+        if tenant is None:
+            t_new = np.zeros(n, dtype=np.int64)
+        else:
+            t_new = np.asarray(tenant, dtype=np.int64)
+            if t_new.shape != (n,):
+                raise ValueError(
+                    f"tenant must have shape ({n},), got {t_new.shape}"
+                )
+        self._tenant = np.concatenate([self._tenant, t_new])
+        if (t_new != 0).any():
+            self._track_tenants = True
+        self._enq_at.extend([now] * n)
         self.stats.lockstep_block_segments += lockstep_block_segments(
             nseg_new, self.block
         )
@@ -363,13 +544,10 @@ class LaneScheduler:
             touched.add(g)
         if self.policy != "fcfs":
             for g in touched:
-                order = policy_order(
-                    np.asarray([self.nseg[s] for s in self._queues[g]]),
-                    self.policy,
+                order = self._order_ids(
+                    np.asarray(self._queues[g], dtype=np.int64)
                 )
-                self._queues[g] = deque(
-                    self._queues[g][int(i)] for i in order
-                )
+                self._queues[g] = deque(int(s) for s in order)
         return new_ids
 
     # -- interval protocol -------------------------------------------
@@ -398,6 +576,14 @@ class LaneScheduler:
         depth = sum(len(q) for q in self._queues)
         st.queue_depth_sum += depth
         st.queue_depth_peak = max(st.queue_depth_peak, depth)
+        if self._track_tenants and live.any():
+            tenants, counts = np.unique(
+                self._tenant[self.lane_sys[live]], return_counts=True
+            )
+            for t, c in zip(tenants, counts):
+                st.tenant_live[int(t)] = (
+                    st.tenant_live.get(int(t), 0) + int(c)
+                )
         return live
 
     def end_interval(self) -> BarrierPlan:
@@ -416,6 +602,12 @@ class LaneScheduler:
                 finished.append((int(lane), int(s)))
                 self.lane_sys[lane] = -1
                 self.lane_seg[lane] = 0
+                dl = self._deadline[s]
+                if dl >= 0:
+                    if self.stats.intervals <= dl:
+                        self.stats.deadline_met += 1
+                    else:
+                        self.stats.deadline_missed += 1
         return self._plan_barrier(finished)
 
     def flush_admissions(self) -> BarrierPlan:
@@ -496,6 +688,9 @@ def simulate(
     threshold: float = 0.5,
     fused: bool = True,
     policy: str = "fcfs",
+    deadline: Optional[np.ndarray] = None,
+    tenant: Optional[np.ndarray] = None,
+    tenant_weights: TenantWeights = None,
 ) -> OccupancyStats:
     """The static occupancy model: replay the scheduling policy from a
     per-system segment-count vector alone.  Because the engines drive
@@ -505,7 +700,8 @@ def simulate(
     counters describe (the policy itself is mode-invariant)."""
     sched = LaneScheduler(
         nseg, resident=resident, block=block, groups=groups,
-        threshold=threshold, policy=policy,
+        threshold=threshold, policy=policy, deadline=deadline,
+        tenant=tenant, tenant_weights=tenant_weights,
     )
     while not sched.done():
         sched.begin_interval()
@@ -554,12 +750,16 @@ def build_plan(
     groups: int = 1,
     threshold: float = 0.5,
     policy: str = "fcfs",
+    deadline: Optional[np.ndarray] = None,
+    tenant: Optional[np.ndarray] = None,
+    tenant_weights: TenantWeights = None,
 ) -> SchedulePlan:
     """Replay the scheduling policy once, up-front, into the dense
     per-interval arrays the fused run program scans over."""
     sched = LaneScheduler(
         nseg, resident=resident, block=block, groups=groups,
-        threshold=threshold, policy=policy,
+        threshold=threshold, policy=policy, deadline=deadline,
+        tenant=tenant, tenant_weights=tenant_weights,
     )
     r = sched.r
     ident = np.arange(r, dtype=np.int32)

@@ -1244,3 +1244,159 @@ class NodeShardedPallasEngine(PallasEngine):
                 "capacity-exact default never overflows)"
             )
         super()._check_status(status, max_cycles)
+
+
+class NodeShardedLaneSession(PallasLaneSession):
+    """The resident-lane serving session on the node-sharded engine:
+    every resident lane's NODE axis is split into contiguous blocks
+    over the mesh's ``node`` axis (composing with ``data`` lane
+    sharding on the same 2-D mesh), so one always-on service hosts
+    jobs bigger than a chip.  Same serving protocol as the base
+    session; operand placement, the runner, and the exchange-overflow
+    status bit mirror :class:`NodeShardedPallasEngine` vs
+    :class:`PallasEngine`, and served dumps stay byte-identical to a
+    one-shot node-sharded run."""
+
+    def __init__(
+        self,
+        config: SystemConfig,
+        resident: int,
+        window: int,
+        *,
+        node_shards: Optional[int] = None,
+        data_shards: int = 1,
+        mesh: Optional[Mesh] = None,
+        exchange_slots: Optional[int] = None,
+        block: int = 1024,
+        **kwargs,
+    ):
+        if mesh is None:
+            if node_shards is None:
+                raise ValueError("pass node_shards or an explicit mesh")
+            mesh = make_mesh(
+                node_shards=node_shards, data_shards=data_shards
+            )
+        if tuple(mesh.axis_names) != ("data", "node"):
+            raise ValueError(
+                f"need a ('data', 'node') mesh, got {mesh.axis_names}"
+            )
+        node_shards = mesh.shape["node"]
+        data_shards = mesh.shape["data"]
+        if node_shards < 2:
+            raise ValueError(
+                "node_shards=1 is the unsharded serving path — use "
+                "PallasLaneSession / DataShardedLaneSession"
+            )
+        if config.num_procs % node_shards != 0:
+            raise ValueError(
+                f"num_procs={config.num_procs} not divisible by node "
+                f"shards={node_shards}"
+            )
+        if resident % data_shards:
+            raise ValueError(
+                f"resident={resident} not divisible by "
+                f"data_shards={data_shards}"
+            )
+        self.mesh = mesh
+        self.node_shards = node_shards
+        self.data_shards = data_shards
+        self._exchange_slots = exchange_slots
+        block = choose_block(resident // data_shards, block)
+        super().__init__(
+            config, resident, window, block=block, **kwargs
+        )
+        # thread the node-sharded transient rows through the carried
+        # state AND the admission-reset init: the barrier closure reads
+        # `self._init` by reference at trace time, so growing the dict
+        # here is visible to the already-built jit.  Resetting a lane's
+        # transients on admission is correct — `activeg` is reseeded
+        # every interval, and `xmsgs`/`exchov` are per-job accumulators
+        # in serving (each lane column belongs to one job at a time).
+        for f in _PALLAS_TRANSIENTS:
+            self._init[f] = jnp.zeros((1, self.r), jnp.int32)
+        self.fields = list(self._init.keys())
+        self.state = {
+            f: self._plane_put(f, v) for f, v in self._init.items()
+        }
+
+    # -- backend hooks --------------------------------------------------
+
+    def _plane_put(self, key: str, v):
+        return jax.device_put(
+            jnp.asarray(v),
+            NamedSharding(self.mesh, _node_plane_spec(key, v.ndim)),
+        )
+
+    def _build_runner(self):
+        max_calls = max(1, -(-self.max_cycles // self.cycles_per_call))
+        return build_node_sharded_pallas_run(
+            self.config, self.r // self.data_shards, False,
+            self.window, 1, max_calls, self.cycles_per_call, self.mesh,
+            self._exchange_slots, self._packed, self._interpret,
+        )
+
+    def _put(self, x):
+        # trailing-lane operands (perm / reset): replicate over node,
+        # shard lanes over data
+        x = jnp.asarray(x)
+        return jax.device_put(
+            x,
+            NamedSharding(
+                self.mesh, P(*([None] * (x.ndim - 1)), "data")
+            ),
+        )
+
+    def _donate_barrier(self) -> bool:
+        # barrier output is re-placed plane-by-plane anyway; skip
+        # donation so XLA never reconciles donated layouts with the
+        # resharding device_put
+        return False
+
+    # -- serving protocol overrides -------------------------------------
+
+    def advance(self, tr, tl):
+        # re-place the runner's output through the SAME key-aware
+        # placement the barrier uses: jit outputs come back with
+        # jax-canonicalized specs (e.g. a size-1 "data" axis dropped),
+        # and alternating input shardings would recompile the runner /
+        # barrier every interval, tripping the zero-recompile guard.
+        # Equivalent-sharding device_puts are transfer-free.
+        self.state, status = self._runner(self.state, tr, tl)
+        self.state = {
+            f: self._plane_put(f, v) for f, v in self.state.items()
+        }
+        return status
+
+    def stage(self, tr_int, tl_int):
+        tr = jax.device_put(
+            jnp.asarray(tr_int),
+            NamedSharding(self.mesh, P("node", None, "data")),
+        )
+        tl = jax.device_put(
+            jnp.asarray(tl_int),
+            NamedSharding(self.mesh, P("node", "data")),
+        )
+        return tr, tl
+
+    def barrier(self, perm, reset) -> None:
+        st = self._barrier_jit(
+            self.state,
+            self._put(jnp.asarray(perm)),
+            self._put(jnp.asarray(reset)),
+        )
+        self.state = {f: self._plane_put(f, v) for f, v in st.items()}
+
+    def check(self, status) -> None:
+        if int(status) & 4:
+            raise StallError(
+                "cross-shard exchange overflow: a cycle had more "
+                "out-bound candidates for one peer shard than "
+                f"exchange_slots={self._exchange_slots}; raise it (the "
+                "capacity-exact default never overflows)"
+            )
+        super().check(status)
+
+    def counters_of(self, cols) -> dict:
+        out = super().counters_of(cols)
+        out["cross_shard_msgs"] = int(np.sum(np.asarray(cols["xmsgs"])))
+        return out

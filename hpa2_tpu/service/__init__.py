@@ -1,0 +1,47 @@
+"""Multi-tenant service plane over the always-on serving loop.
+
+- :mod:`hpa2_tpu.service.wire` — the length-prefixed framed protocol:
+  versioned frames for SUBMIT / ACK / RESULT streaming / NACK, with
+  credit-based backpressure (overflow is a loud NACK, never a silent
+  drop).
+- :mod:`hpa2_tpu.service.admission` — tenant weights, deadline
+  classes, and the thread-safe admission ledger that fixes job order
+  by ack sequence.
+- :mod:`hpa2_tpu.service.frontend` — :class:`WireJobSource`, the
+  framed TCP listener the serving loop polls; results stream back to
+  the owning connection.
+
+Quick start (server side)::
+
+    from hpa2_tpu.service import TenantTable, WireJobSource
+    from hpa2_tpu.serving import serve
+
+    source = WireJobSource(config, tenants=TenantTable.parse("a:2,b:1"))
+    print("listening on", source.address)
+    results, stats = serve(config, source, policy="fair-drr",
+                           emit=source.deliver,
+                           tenant_weights=source.tenant_weights)
+
+and the client::
+
+    from hpa2_tpu.service import WireClient
+    with WireClient(host, port) as cli:
+        ack = cli.submit({"id": "j0", "tenant": "a", "traces": ...})
+        results = cli.finish()
+"""
+
+from hpa2_tpu.service.admission import (
+    DEADLINE_CLASSES, AdmissionLedger, AdmissionReject, TenantTable,
+    resolve_deadline)
+from hpa2_tpu.service.frontend import WireJobSource
+from hpa2_tpu.service.wire import (
+    ACK, BYE, CREDIT, EOF, HELLO, NACK, RESULT, SUBMIT, Frame,
+    FrameReader, WireClient, WireError, WireNack, encode_frame)
+
+__all__ = [
+    "ACK", "BYE", "CREDIT", "DEADLINE_CLASSES", "EOF", "Frame",
+    "FrameReader", "HELLO", "NACK", "RESULT", "SUBMIT",
+    "AdmissionLedger", "AdmissionReject", "TenantTable", "WireClient",
+    "WireError", "WireJobSource", "WireNack", "encode_frame",
+    "resolve_deadline",
+]

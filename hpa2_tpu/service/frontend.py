@@ -1,0 +1,224 @@
+"""The wire frontend: a framed TCP listener that feeds the serving
+loop through the admission ledger.
+
+:class:`WireJobSource` is a drop-in
+:class:`~hpa2_tpu.serving.ingest.JobSource`: the serving loop polls it
+once per admission opportunity exactly like the JSONL socket feed, but
+every submission is acknowledged (ACK with the global admission seq)
+or rejected loudly (NACK with a reason), and each connection is
+credit-clocked so overload pushes back instead of silently dropping.
+
+``poll()`` drains **one admission wave** from the ledger in seq order
+— many small jobs arriving between two scheduler intervals enter the
+scheduler as one batch, ordered by their ack sequence numbers, not by
+reader-thread timing.  Results stream back to the *owning* connection
+as RESULT frames via :meth:`WireJobSource.deliver` (pass it as the
+serving loop's ``emit`` callback); once a connection has sent EOF and
+its last result is delivered the server answers BYE and closes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+from typing import Dict, List, Optional
+
+from hpa2_tpu.config import SystemConfig
+from hpa2_tpu.serving.ingest import JobSource
+from hpa2_tpu.serving.jobs import Job, JobResult, job_from_record
+from hpa2_tpu.service.admission import (
+    AdmissionLedger, AdmissionReject, TenantTable, resolve_deadline)
+from hpa2_tpu.service.wire import (
+    ACK, BYE, CREDIT, EOF, HELLO, NACK, RESULT, SUBMIT, VERSION,
+    FrameReader, WireError, encode_frame)
+
+
+class _Conn:
+    """One client connection: socket + send lock (the reader thread
+    answers ACK/NACK while the serving thread streams RESULT/CREDIT)."""
+
+    def __init__(self, conn_id: int, sock: socket.socket):
+        self.id = conn_id
+        self.sock = sock
+        self.lock = threading.Lock()
+        self.outstanding = 0   # accepted submits awaiting RESULT
+        self.eof = False       # client finished submitting
+        self.dead = False
+
+    def send(self, ftype: int, payload: Optional[dict] = None) -> None:
+        data = encode_frame(ftype, payload)
+        with self.lock:
+            if self.dead:
+                return
+            try:
+                self.sock.sendall(data)
+            except OSError:
+                self.dead = True
+
+    def close(self) -> None:
+        with self.lock:
+            self.dead = True
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
+class WireJobSource(JobSource):
+    """Framed multi-tenant TCP feed (see the module docstring)."""
+
+    def __init__(self, config: SystemConfig, host: str = "127.0.0.1",
+                 port: int = 0, *, credits: int = 64, backlog: int = 8,
+                 tenants: Optional[TenantTable] = None):
+        self._config = config
+        self.tenants = tenants or TenantTable()
+        self.ledger = AdmissionLedger(credits)
+        self._lock = threading.Lock()
+        self._conns: Dict[int, _Conn] = {}
+        self._owner: Dict[str, _Conn] = {}
+        self._open: set = set()    # conn ids still submitting
+        self._saw_conn = False
+        self._ids = itertools.count()
+        self._closed = threading.Event()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(backlog)
+        self._srv.settimeout(0.1)
+        self.address = self._srv.getsockname()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    @property
+    def tenant_weights(self) -> Optional[Dict[str, float]]:
+        """The weight dict ``serve(tenant_weights=...)`` wants."""
+        return dict(self.tenants.weights) or None
+
+    # -- listener ------------------------------------------------------
+
+    def _serve(self) -> None:
+        while not self._closed.is_set():
+            try:
+                sock, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            c = _Conn(next(self._ids), sock)
+            with self._lock:
+                self._conns[c.id] = c
+                self._open.add(c.id)
+                self._saw_conn = True
+            budget = self.ledger.register(c.id)
+            c.send(HELLO, {"version": VERSION, "credits": budget})
+            threading.Thread(
+                target=self._read_conn, args=(c,), daemon=True
+            ).start()
+
+    def _read_conn(self, c: _Conn) -> None:
+        reader = FrameReader()
+        try:
+            while not c.eof:
+                data = c.sock.recv(65536)
+                if not data:
+                    break
+                for fr in reader.feed(data):
+                    if fr.ftype == SUBMIT:
+                        self._on_submit(c, fr.payload)
+                    elif fr.ftype == EOF:
+                        with self._lock:
+                            c.eof = True
+                            self._open.discard(c.id)
+                        self._maybe_bye(c)
+                        break
+                    else:
+                        raise WireError(
+                            f"unexpected client frame {fr.ftype}")
+        except (OSError, WireError, ValueError):
+            # abrupt disconnect or framing violation: drop the
+            # connection; everything already ACK'd stays admitted
+            c.close()
+        finally:
+            with self._lock:
+                self._open.discard(c.id)
+            if c.dead:
+                self.ledger.forget(c.id)
+        # reader exits after EOF with the socket open — the serving
+        # thread still streams RESULT frames and the closing BYE
+
+    def _on_submit(self, c: _Conn, record: dict) -> None:
+        job_id = record.get("id")
+        try:
+            seq, pos = self.ledger.try_submit(c.id, record)
+        except AdmissionReject as e:
+            c.send(NACK, {"id": job_id, "reason": str(e)})
+            return
+        with self._lock:
+            self._owner[str(job_id)] = c
+            c.outstanding += 1
+        c.send(ACK, {"id": job_id, "seq": seq, "queue_pos": pos})
+
+    # -- the serving loop side ----------------------------------------
+
+    def poll(self) -> List[Job]:
+        wave, back = self.ledger.take_wave()
+        jobs: List[Job] = []
+        for p in wave:
+            rec = dict(p.record)
+            rec["deadline"] = resolve_deadline(rec)
+            try:
+                jobs.append(job_from_record(self._config, rec))
+            except ValueError as e:
+                # malformed past the ledger's checks (bad trace body):
+                # still loud — a post-ack NACK, never a silent drop
+                c = self._owner.pop(str(rec.get("id")), None)
+                if c is not None:
+                    c.send(NACK,
+                           {"id": rec.get("id"), "reason": str(e)})
+                    with self._lock:
+                        c.outstanding -= 1
+                    self._maybe_bye(c)
+        for conn_id, n in back.items():
+            c = self._conns.get(conn_id)
+            if c is not None:
+                c.send(CREDIT, {"credits": n})
+        return jobs
+
+    def deliver(self, result: JobResult) -> None:
+        """Stream one result to its owning connection (pass as the
+        serving loop's ``emit`` callback)."""
+        c = self._owner.pop(result.job_id, None)
+        if c is None:
+            return
+        c.send(RESULT, result.to_record())
+        with self._lock:
+            c.outstanding -= 1
+        self._maybe_bye(c)
+
+    def _maybe_bye(self, c: _Conn) -> None:
+        with self._lock:
+            done = c.eof and c.outstanding <= 0
+        if done:
+            c.send(BYE)
+            c.close()
+            self.ledger.forget(c.id)
+
+    @property
+    def exhausted(self) -> bool:
+        if self._closed.is_set():
+            return self.ledger.pending == 0
+        with self._lock:
+            drained = self._saw_conn and not self._open
+        return drained and self.ledger.pending == 0
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            c.close()

@@ -1,0 +1,211 @@
+"""The framed wire protocol of the multi-tenant service plane.
+
+The JSONL socket feed (:class:`~hpa2_tpu.serving.ingest.\
+SocketJobSource`) is fire-and-forget: a client never learns whether a
+job was admitted, results don't come back, and overload is a silent
+drop at the TCP buffer.  The service plane replaces it with a
+length-prefixed *framed* protocol with explicit acknowledgement and
+credit-based backpressure:
+
+- every frame is an 8-byte header + a JSON payload::
+
+      >BBBxI  = magic (0xA2) | version (1) | type | pad | payload len
+
+- the server opens with HELLO advertising this connection's admission
+  *credits*; each SUBMIT consumes one credit and draws either an ACK
+  (``{"id", "seq", "queue_pos", "credits"}``) or a loud NACK
+  (``{"id", "reason"}``) — **never** a silent drop;
+- credits replenish via CREDIT frames as submitted jobs are admitted
+  into the scheduler, so a well-behaved client self-clocks to the
+  server's admission rate;
+- results stream back as RESULT frames while the connection is still
+  submitting; EOF (client) / BYE (server) close the conversation.
+
+ACK ``seq`` is the global admission sequence number — the order jobs
+enter the scheduler, fixed at SUBMIT time by the server, independent
+of client thread timing.  That is what makes multi-client ingest
+deterministic *given the ack transcript*.
+
+The JSONL feed remains for offline replay (jobs files); this module is
+the live path.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Dict, List, Optional, Tuple
+
+MAGIC = 0xA2
+VERSION = 1
+
+# frame types
+HELLO = 1    # server -> client: {"version", "credits"}
+SUBMIT = 2   # client -> server: a job record (jobs.py JSONL schema)
+ACK = 3      # server -> client: {"id", "seq", "queue_pos", "credits"}
+NACK = 4     # server -> client: {"id", "reason"}
+RESULT = 5   # server -> client: a JobResult record chunk
+CREDIT = 6   # server -> client: {"credits": n} replenish
+EOF = 7      # client -> server: done submitting on this connection
+BYE = 8      # server -> client: all results delivered, closing
+
+FRAME_NAMES = {
+    HELLO: "HELLO", SUBMIT: "SUBMIT", ACK: "ACK", NACK: "NACK",
+    RESULT: "RESULT", CREDIT: "CREDIT", EOF: "EOF", BYE: "BYE",
+}
+
+_HEADER = struct.Struct(">BBBxI")
+MAX_PAYLOAD = 1 << 24  # 16 MiB — far beyond any job record
+
+
+class WireError(Exception):
+    """Framing violation: bad magic/version/type or oversized frame."""
+
+
+class WireNack(Exception):
+    """A SUBMIT was rejected by the server (the payload says why)."""
+
+    def __init__(self, payload: dict):
+        super().__init__(payload.get("reason", "rejected"))
+        self.payload = payload
+
+
+def encode_frame(ftype: int, payload: Optional[dict] = None) -> bytes:
+    if ftype not in FRAME_NAMES:
+        raise WireError(f"unknown frame type {ftype}")
+    body = b"" if payload is None else json.dumps(
+        payload, separators=(",", ":")).encode("utf-8")
+    if len(body) > MAX_PAYLOAD:
+        raise WireError(
+            f"frame payload {len(body)} bytes exceeds {MAX_PAYLOAD}")
+    return _HEADER.pack(MAGIC, VERSION, ftype, len(body)) + body
+
+
+class Frame:
+    __slots__ = ("ftype", "payload")
+
+    def __init__(self, ftype: int, payload: dict):
+        self.ftype = ftype
+        self.payload = payload
+
+    def __repr__(self) -> str:
+        name = FRAME_NAMES.get(self.ftype, self.ftype)
+        return f"Frame({name}, {self.payload!r})"
+
+
+class FrameReader:
+    """Incremental frame parser: ``feed(chunk)`` returns every frame
+    completed by that chunk, buffering any partial tail.  Byte-at-a-
+    time feeding reassembles identically — framing never depends on
+    TCP segmentation."""
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[Frame]:
+        self._buf.extend(data)
+        out: List[Frame] = []
+        while True:
+            if len(self._buf) < _HEADER.size:
+                return out
+            magic, version, ftype, length = _HEADER.unpack_from(self._buf)
+            if magic != MAGIC:
+                raise WireError(f"bad magic 0x{magic:02x}")
+            if version != VERSION:
+                raise WireError(
+                    f"wire version {version} != {VERSION}")
+            if ftype not in FRAME_NAMES:
+                raise WireError(f"unknown frame type {ftype}")
+            if length > MAX_PAYLOAD:
+                raise WireError(
+                    f"frame payload {length} bytes exceeds {MAX_PAYLOAD}")
+            if len(self._buf) < _HEADER.size + length:
+                return out
+            body = bytes(self._buf[_HEADER.size:_HEADER.size + length])
+            del self._buf[:_HEADER.size + length]
+            payload = json.loads(body.decode("utf-8")) if body else {}
+            out.append(Frame(ftype, payload))
+
+
+class WireClient:
+    """A blocking framed client for tests, benchmarks and the CLI.
+
+    ``submit()`` consumes one local credit (blocking on CREDIT
+    replenishment when out) and returns the server's ACK payload;
+    a NACK raises :class:`WireNack`.  ``force=True`` skips the local
+    credit gate — the way to *prove* the server NACKs over-submission
+    instead of dropping it.  RESULT frames that arrive interleaved are
+    collected on :attr:`results`; ``finish()`` sends EOF and drains to
+    BYE."""
+
+    def __init__(self, host: str, port: int, *,
+                 timeout_s: float = 30.0):
+        self._sock = socket.create_connection(
+            (host, port), timeout=timeout_s)
+        self._reader = FrameReader()
+        self._inbox: List[Frame] = []
+        self.results: List[dict] = []
+        self.credits = 0
+        hello = self._next_frame((HELLO,))
+        if hello.payload.get("version") != VERSION:
+            raise WireError(
+                f"server wire version {hello.payload.get('version')}"
+                f" != {VERSION}")
+        self.credits = int(hello.payload.get("credits", 0))
+
+    # -- frame plumbing -----------------------------------------------
+
+    def _pump(self) -> None:
+        data = self._sock.recv(65536)
+        if not data:
+            raise WireError("server closed the connection mid-stream")
+        self._inbox.extend(self._reader.feed(data))
+
+    def _next_frame(self, wanted: Tuple[int, ...]) -> Frame:
+        """Return the next frame of a wanted type, absorbing RESULT
+        and CREDIT frames that arrive in between."""
+        while True:
+            while self._inbox:
+                fr = self._inbox.pop(0)
+                if fr.ftype == RESULT:
+                    self.results.append(fr.payload)
+                elif fr.ftype == CREDIT:
+                    self.credits += int(fr.payload.get("credits", 0))
+                if fr.ftype in wanted:
+                    return fr
+            self._pump()
+
+    # -- the conversation ---------------------------------------------
+
+    def submit(self, record: dict, *, force: bool = False) -> dict:
+        if not force:
+            while self.credits <= 0:
+                # blocked on backpressure: wait for a CREDIT frame
+                self._next_frame((CREDIT,))
+        self._sock.sendall(encode_frame(SUBMIT, record))
+        self.credits -= 1
+        fr = self._next_frame((ACK, NACK))
+        if fr.ftype == NACK:
+            # a rejected submit never consumed a server credit
+            self.credits += 1
+            raise WireNack(fr.payload)
+        return fr.payload
+
+    def finish(self) -> List[dict]:
+        """EOF, then drain RESULT frames until the server says BYE."""
+        self._sock.sendall(encode_frame(EOF))
+        self._next_frame((BYE,))
+        return self.results
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "WireClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

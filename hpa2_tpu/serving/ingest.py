@@ -129,22 +129,32 @@ class SocketJobSource(JobSource):
             t.start()
 
     def _read_conn(self, conn: socket.socket) -> None:
-        with conn, conn.makefile("r", encoding="utf-8") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if rec.get("eof"):
-                    self._eof.set()
-                    break
-                try:
-                    self._queue.put(job_from_record(self._config, rec))
-                except ValueError:
-                    continue
+        # An abrupt client disconnect (RST mid-line, half-open reset)
+        # surfaces as ConnectionResetError / OSError from the iterator
+        # or the close; swallow it so the reader thread dies quietly —
+        # every complete record already parsed stays in the queue, and
+        # a partial final line simply fails json.loads and is dropped.
+        try:
+            with conn, conn.makefile("r", encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("eof"):
+                        self._eof.set()
+                        break
+                    try:
+                        self._queue.put(
+                            job_from_record(self._config, rec)
+                        )
+                    except ValueError:
+                        continue
+        except (OSError, ValueError):
+            pass
 
     def poll(self) -> List[Job]:
         out = []

@@ -31,7 +31,14 @@ from hpa2_tpu.utils.dump import NodeDump
 
 @dataclasses.dataclass
 class Job:
-    """One simulation job: ``[n, t]`` per-node trace arrays."""
+    """One simulation job: ``[n, t]`` per-node trace arrays.
+
+    The multi-tenant service fields default to "anonymous, best
+    effort": ``tenant`` names the submitting tenant ("" = the default
+    tenant), ``priority`` breaks ties in the admission scheduler
+    (higher first, reserved), and ``deadline`` is the completion
+    deadline in scheduling intervals relative to admission enqueue
+    (-1 = none)."""
 
     job_id: str
     tr_op: np.ndarray    # [n, t] int, 0=RD 1=WR
@@ -39,6 +46,9 @@ class Job:
     tr_val: np.ndarray   # [n, t] int
     tr_len: np.ndarray   # [n] int
     arrival: float = 0.0
+    tenant: str = ""
+    priority: int = 0
+    deadline: int = -1
 
     @property
     def max_len(self) -> int:
@@ -75,18 +85,22 @@ class JobResult:
     submitted_s: float
     retired_s: float
     wait_intervals: int
+    tenant: str = ""
 
     @property
     def latency_s(self) -> float:
         return self.retired_s - self.submitted_s
 
     def to_record(self) -> dict:
-        return {
+        rec = {
             "id": self.job_id,
             "latency_s": round(self.latency_s, 6),
             "wait_intervals": self.wait_intervals,
             **self.counters,
         }
+        if self.tenant:
+            rec["tenant"] = self.tenant
+        return rec
 
 
 def _trace_arrays(config: SystemConfig, traces: Sequence[Sequence]):
@@ -149,9 +163,14 @@ def job_from_record(config: SystemConfig, record: dict) -> Job:
             f"job {job_id!r} needs exactly one of 'traces'/'workload'"
         )
     if "workload" in record:
-        return _workload_job(config, job_id, record["workload"], arrival)
-    op, addr, val, ln = _trace_arrays(config, record["traces"])
-    return Job(job_id, op, addr, val, ln, arrival=arrival)
+        job = _workload_job(config, job_id, record["workload"], arrival)
+    else:
+        op, addr, val, ln = _trace_arrays(config, record["traces"])
+        job = Job(job_id, op, addr, val, ln, arrival=arrival)
+    job.tenant = str(record.get("tenant", ""))
+    job.priority = int(record.get("priority", 0))
+    job.deadline = int(record.get("deadline", -1))
+    return job
 
 
 def job_to_record(job: Job) -> dict:
@@ -170,6 +189,12 @@ def job_to_record(job: Job) -> dict:
     rec = {"id": job.job_id, "traces": traces}
     if job.arrival:
         rec["arrival"] = job.arrival
+    if job.tenant:
+        rec["tenant"] = job.tenant
+    if job.priority:
+        rec["priority"] = job.priority
+    if job.deadline >= 0:
+        rec["deadline"] = job.deadline
     return rec
 
 

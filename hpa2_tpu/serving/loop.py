@@ -269,12 +269,23 @@ class ServingSession:
         emit: Optional[Callable[[JobResult], None]] = None,
         compile_guard: bool = True,
         backend: str = "pallas",
+        tenant_weights: Optional[Dict[str, float]] = None,
     ):
         self.session = session
         self.source = source
+        # tenant names -> dense integer ids, default tenant "" first;
+        # the id-keyed weight dict is handed to the scheduler BY
+        # REFERENCE so names first seen later still order correctly
+        self._tenant_ids: Dict[str, int] = {}
+        self._tenant_weights_named = dict(tenant_weights or {})
+        self._tenant_weights_by_id: Dict[int, float] = {}
+        self._tenant_id("")
+        for name in self._tenant_weights_named:
+            self._tenant_id(name)
         self.sched = LaneScheduler.serving(
             session.r, block=session.block, groups=groups,
             threshold=threshold, policy=policy,
+            tenant_weights=self._tenant_weights_by_id,
         )
         self.pool = TracePool(session.config, session.window)
         self.overlap = overlap
@@ -290,19 +301,35 @@ class ServingSession:
 
     # -- pipeline pieces ----------------------------------------------
 
+    def _tenant_id(self, name: str) -> int:
+        tid = self._tenant_ids.get(name)
+        if tid is None:
+            tid = len(self._tenant_ids)
+            self._tenant_ids[name] = tid
+            w = self._tenant_weights_named.get(name)
+            if w is not None:
+                self._tenant_weights_by_id[tid] = float(w)
+        return tid
+
     def _ingest(self) -> None:
         t0 = time.perf_counter()
         arrived = self.source.poll()
         if arrived:
             now = time.perf_counter()
-            nseg = []
+            nseg, dls, tns = [], [], []
             for job in arrived:
                 s = self.pool.add(job)
                 assert s == len(self._jobs)
                 self._jobs.append(job)
                 self._submitted.append(now)
                 nseg.append(self.pool.nseg_of(s))
-            self.sched.extend(np.asarray(nseg, np.int64))
+                dls.append(int(job.deadline))
+                tns.append(self._tenant_id(job.tenant))
+            self.sched.extend(
+                np.asarray(nseg, np.int64),
+                deadline=np.asarray(dls, np.int64),
+                tenant=np.asarray(tns, np.int64),
+            )
             self.stats.jobs_submitted += len(arrived)
         self.stats.host_staging_s += time.perf_counter() - t0
 
@@ -345,6 +372,7 @@ class ServingSession:
                 submitted_s=self._submitted[s],
                 retired_s=time.perf_counter(),
                 wait_intervals=self._wait_of.get(s, 0),
+                tenant=job.tenant,
             )
             self.pool.free(s)
             self.results.append(res)
@@ -438,6 +466,7 @@ BatchLaneSession` rows.  Row completion is device quiescence, so the
         emit: Optional[Callable[[JobResult], None]] = None,
         compile_guard: bool = True,
         backend: str = "jax",
+        tenant_weights: Optional[Dict[str, float]] = None,
     ):
         self.session = session
         self.source = source
@@ -448,10 +477,27 @@ BatchLaneSession` rows.  Row completion is device quiescence, so the
         self.compile_guard = compile_guard
         self._jobs: List[Job] = []
         self._submitted: List[float] = []
+        self._tenant_ids: Dict[str, int] = {"": 0}
+        self._tenant_weights_named = dict(tenant_weights or {})
+        self._tenant_weights_by_id: Dict[int, float] = {}
+        for name in self._tenant_weights_named:
+            self._tid(name)
+        self._tenant_of: Dict[int, int] = {}   # system -> tenant id
+        self._dl_abs: Dict[int, int] = {}      # system -> abs deadline
         self.stats = ServingStats(
             backend=backend, policy=policy, resident=session.r,
             overlap=overlap,
         )
+
+    def _tid(self, name: str) -> int:
+        tid = self._tenant_ids.get(name)
+        if tid is None:
+            tid = len(self._tenant_ids)
+            self._tenant_ids[name] = tid
+            w = self._tenant_weights_named.get(name)
+            if w is not None:
+                self._tenant_weights_by_id[tid] = float(w)
+        return tid
 
     def _poll(self, queue: deque, enq_at: Dict[int, int],
               chunk: int) -> None:
@@ -465,11 +511,29 @@ BatchLaneSession` rows.  Row completion is device quiescence, so the
                 self._submitted.append(now)
                 queue.append(s)
                 enq_at[s] = chunk
-            if self.policy != "fcfs":
-                keys = np.asarray(
-                    [self._jobs[s].max_len for s in queue]
+                self._tenant_of[s] = self._tid(job.tenant)
+                self._dl_abs[s] = (
+                    chunk + job.deadline if job.deadline >= 0 else -1
                 )
-                order = policy_order(keys, self.policy)
+            if self.policy != "fcfs":
+                # fair-drr charges one row per job (keys of one) —
+                # row-granularity serving has no segment cost
+                if self.policy == "fair-drr":
+                    keys = np.ones(len(queue), dtype=np.int64)
+                else:
+                    keys = np.asarray(
+                        [self._jobs[s].max_len for s in queue]
+                    )
+                order = policy_order(
+                    keys, self.policy,
+                    deadline=np.asarray(
+                        [self._dl_abs[s] for s in queue], np.int64
+                    ),
+                    tenant=np.asarray(
+                        [self._tenant_of[s] for s in queue], np.int64
+                    ),
+                    weights=self._tenant_weights_by_id,
+                )
                 items = list(queue)
                 queue.clear()
                 queue.extend(items[int(i)] for i in order)
@@ -493,7 +557,8 @@ BatchLaneSession` rows.  Row completion is device quiescence, so the
         return staged
 
     def _harvest(self, row_sys: np.ndarray, quiet: np.ndarray,
-                 wait_of: Dict[int, int]) -> None:
+                 wait_of: Dict[int, int], occ: OccupancyStats,
+                 chunk: int) -> None:
         sess = self.session
         done_rows = [
             int(i) for i in np.nonzero((row_sys >= 0) & quiet)[0]
@@ -505,6 +570,12 @@ BatchLaneSession` rows.  Row completion is device quiescence, so the
         for idx, row in zip(done_rows, rows):
             s = int(row_sys[idx])
             job = self._jobs[s]
+            dl = self._dl_abs.get(s, -1)
+            if dl >= 0:
+                if chunk <= dl:
+                    occ.deadline_met += 1
+                else:
+                    occ.deadline_missed += 1
             counters = sess.counters_of(row)
             res = JobResult(
                 job_id=job.job_id,
@@ -513,6 +584,7 @@ BatchLaneSession` rows.  Row completion is device quiescence, so the
                 submitted_s=self._submitted[s],
                 retired_s=time.perf_counter(),
                 wait_intervals=wait_of.get(s, 0),
+                tenant=job.tenant,
             )
             self.results.append(res)
             self.stats.jobs_completed += 1
@@ -534,6 +606,10 @@ BatchLaneSession` rows.  Row completion is device quiescence, so the
         # so both segment counters accrue the live-row work
         occ.block_segments += live
         occ.lockstep_block_segments += live
+        if self._tenant_weights_by_id or len(self._tenant_ids) > 1:
+            for s in row_sys[row_sys >= 0]:
+                t = self._tenant_of.get(int(s), 0)
+                occ.tenant_live[t] = occ.tenant_live.get(t, 0) + 1
         depth = len(queue)
         occ.queue_depth_sum += depth
         occ.queue_depth_peak = max(occ.queue_depth_peak, depth)
@@ -578,7 +654,7 @@ BatchLaneSession` rows.  Row completion is device quiescence, so the
                 st.device_wait_s += time.perf_counter() - t0
                 chunk += 1
                 self._account_chunk(occ, row_sys, row_age, queue)
-                self._harvest(row_sys, quiet, wait_of)
+                self._harvest(row_sys, quiet, wait_of, occ, chunk)
             else:
                 staged = self._stage(queue, free)
             for idx, s, row in staged:
@@ -601,7 +677,7 @@ BatchLaneSession` rows.  Row completion is device quiescence, so the
                 st.device_wait_s += time.perf_counter() - t0
                 chunk += 1
                 self._account_chunk(occ, row_sys, row_age, queue)
-                self._harvest(row_sys, quiet, wait_of)
+                self._harvest(row_sys, quiet, wait_of, occ, chunk)
         st.wall_s = time.perf_counter() - wall0
         st.occupancy = occ.as_dict()
         st.compile_counts = sess.compile_counts()
@@ -619,6 +695,7 @@ def serve(
     block: Optional[int] = None,
     policy: str = "fcfs",
     data_shards: int = 1,
+    node_shards: int = 1,
     overlap: bool = True,
     interval: int = 256,
     max_trace_len: int = 1024,
@@ -628,12 +705,14 @@ def serve(
     emit: Optional[Callable[[JobResult], None]] = None,
     compile_guard: bool = True,
     interpret: Optional[bool] = None,
+    tenant_weights: Optional[Dict[str, float]] = None,
 ) -> Tuple[List[JobResult], ServingStats]:
     """Build the right resident session for ``backend`` and drive the
     source to exhaustion.  Backends: ``pallas`` (the fast path),
     ``pallas-sharded`` (data-parallel lanes over ``data_shards``
-    devices), ``jax`` (the XLA batch engine — the only backend with
-    fault injection)."""
+    devices), ``pallas-node-sharded`` (each system's node axis split
+    over ``node_shards`` devices — jobs bigger than a chip), ``jax``
+    (the XLA batch engine — the only backend with fault injection)."""
     if backend == "pallas":
         from hpa2_tpu.ops.pallas_engine import PallasLaneSession
 
@@ -645,6 +724,7 @@ def serve(
             sess, source, policy=policy, threshold=threshold,
             overlap=overlap, decode_dumps=decode_dumps, emit=emit,
             compile_guard=compile_guard, backend=backend,
+            tenant_weights=tenant_weights,
         )
     elif backend == "pallas-sharded":
         from hpa2_tpu.parallel.sharding import DataShardedLaneSession
@@ -659,6 +739,22 @@ def serve(
             threshold=threshold, overlap=overlap,
             decode_dumps=decode_dumps, emit=emit,
             compile_guard=compile_guard, backend=backend,
+            tenant_weights=tenant_weights,
+        )
+    elif backend == "pallas-node-sharded":
+        from hpa2_tpu.parallel.sharding import NodeShardedLaneSession
+
+        sess = NodeShardedLaneSession(
+            config, resident, window, node_shards=node_shards,
+            data_shards=data_shards, block=block or 1024,
+            interpret=interpret, max_cycles=max_cycles,
+        )
+        drv = ServingSession(
+            sess, source, policy=policy, groups=sess.data_shards,
+            threshold=threshold, overlap=overlap,
+            decode_dumps=decode_dumps, emit=emit,
+            compile_guard=compile_guard, backend=backend,
+            tenant_weights=tenant_weights,
         )
     elif backend == "jax":
         from hpa2_tpu.ops.engine import BatchLaneSession
@@ -671,10 +767,11 @@ def serve(
             sess, source, policy=policy, overlap=overlap,
             decode_dumps=decode_dumps, emit=emit,
             compile_guard=compile_guard, backend=backend,
+            tenant_weights=tenant_weights,
         )
     else:
         raise ValueError(
             f"unknown serving backend {backend!r}; expected "
-            "pallas | pallas-sharded | jax"
+            "pallas | pallas-sharded | pallas-node-sharded | jax"
         )
     return drv.run()

@@ -52,6 +52,11 @@ Steps, in value order:
                      p50/p99 job latency under Poisson and heavy-tail
                      arrivals, with the pipelined-vs-serial staging
                      overlap split
+  serve_mt512        ISSUE-14 multi-tenant service plane at 32768
+                     resident lanes: capacity under fair-drr
+                     admission plus the 4-weighted-tenant deadline
+                     mix (per-tenant p50/p99 latency, tenant_share,
+                     deadline hit rate)
   elision512         ISSUE-12 event-driven cycle elision at the
                      shipped batch shape (32768 lanes, zipf 8x
                      private hot sets) on the batched XLA engine:
@@ -693,6 +698,23 @@ def main() -> int:
                 timeout_s=3600, argv=True))
         finally:
             os.environ.pop("HPA2_SERVE_RESIDENT", None)
+
+    if "serve_mt512" not in skip and gate("serve_mt512"):
+        # ISSUE-14: the multi-tenant service plane at the shipped
+        # 32768 resident shape — the capacity runs under fair-drr
+        # admission, plus the bench's multi_tenant section (4 weighted
+        # tenants with an interactive/standard/batch deadline mix:
+        # per-tenant p50/p99 latency, tenant_share, deadline hit rate)
+        os.environ["HPA2_SERVE_RESIDENT"] = "32768"
+        os.environ["HPA2_SERVE_POLICY"] = "fair-drr"
+        try:
+            note(run_py(
+                "serve_mt512",
+                [os.path.join(REPO, "bench.py"), "--serve"],
+                timeout_s=3600, argv=True))
+        finally:
+            os.environ.pop("HPA2_SERVE_RESIDENT", None)
+            os.environ.pop("HPA2_SERVE_POLICY", None)
 
     if "elision512" not in skip and gate("elision512"):
         # ISSUE-12: event-driven cycle elision at the shipped batch

@@ -151,6 +151,64 @@ then
   echo "TIER1: serving smoke failed" >&2
   exit 1
 fi
+# Service smoke (~30s, CPU interpret): the ISSUE-14 service plane — a
+# loopback framed-wire client submits a 2-tenant feed, every SUBMIT
+# must draw an ACK whose seq fixes the admission order, results must
+# stream back over the connection, and the served dumps must stay
+# byte-identical to the one-shot scheduled run under fair-drr.
+# Catches wire/ledger/scheduler-policy wiring breaks cheaply.
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python - > /dev/null <<'EOF'
+import threading
+import numpy as np
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.ops.pallas_engine import PallasEngine
+from hpa2_tpu.ops.schedule import Schedule
+from hpa2_tpu.serving import synthetic_jobs, job_to_record, serve
+from hpa2_tpu.service import TenantTable, WireClient, WireJobSource
+
+cfg = SystemConfig(num_procs=4, semantics=Semantics().robust())
+jobs = synthetic_jobs(cfg, 8, 24, seed=7, spread=3.0)
+recs = [job_to_record(j) for j in jobs]
+for i, r in enumerate(recs):
+    r["tenant"] = ("a", "b")[i % 2]
+ref = PallasEngine(
+    cfg,
+    np.stack([j.tr_op for j in jobs]),
+    np.stack([j.tr_addr for j in jobs]),
+    np.stack([j.tr_val for j in jobs]),
+    np.stack([j.tr_len for j in jobs]),
+    block=4, trace_window=8, snapshots=False,
+    schedule=Schedule(resident=4, fused=False),
+).run()
+src = WireJobSource(cfg, tenants=TenantTable.parse("a:2,b:1"),
+                    credits=16)
+acks, streamed = [], []
+def client():
+    with WireClient(*src.address) as cli:
+        for r in recs:
+            acks.append(cli.submit(r))
+        streamed.extend(cli.finish())
+t = threading.Thread(target=client)
+t.start()
+results, stats = serve(cfg, src, backend="pallas", resident=4,
+                       window=8, block=4, policy="fair-drr",
+                       emit=src.deliver,
+                       tenant_weights=src.tenant_weights)
+t.join(timeout=30)
+assert [a["seq"] for a in acks] == list(range(8)), acks
+assert sorted(r["id"] for r in streamed) == sorted(
+    j.job_id for j in jobs)
+for s, j in enumerate(jobs):
+    r = next(r for r in results if r.job_id == j.job_id)
+    assert r.dumps == ref.system_final_dumps(s), j.job_id
+assert "tenant_share" in stats.occupancy, stats.occupancy
+assert all(c == 1 for c in stats.compile_counts.values()), \
+    stats.compile_counts
+EOF
+then
+  echo "TIER1: service smoke failed" >&2
+  exit 1
+fi
 # Elision smoke (~30s, CPU): the ISSUE-12 event-driven loop — a
 # scheduled zipf hot-set run must actually elide cycles, stay
 # byte-identical to the elide=False lockstep run, and the exact-replay

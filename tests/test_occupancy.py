@@ -487,7 +487,33 @@ def test_policy_order_unit():
     with pytest.raises(ValueError, match="unknown policy"):
         LaneScheduler(np.ones(8, np.int64), resident=4, block=4,
                       policy="bogus")
-    assert set(POLICIES) == {"fcfs", "longest-first"}
+    assert set(POLICIES) == {
+        "fcfs", "longest-first", "deadline-edf", "fair-drr"
+    }
+
+    # deadline-edf: ascending absolute deadline, -1 (no deadline) last,
+    # stable among ties; without metadata it degrades to fcfs
+    edf = policy_order(
+        np.array([3, 7, 7]), "deadline-edf",
+        deadline=np.array([5, -1, 2]),
+    )
+    assert edf.tolist() == [2, 0, 1]
+    assert policy_order(np.array([3, 7]), "deadline-edf").tolist() == [0, 1]
+
+    # fair-drr: weighted deficit round robin, deterministic.  Unit
+    # costs, tenants 0/1 with weight 1:2 -> tenant 1 releases two jobs
+    # per turn to tenant 0's one, arrival order within each tenant.
+    drr = policy_order(
+        np.ones(6, np.int64), "fair-drr",
+        tenant=np.array([0, 0, 0, 1, 1, 1]),
+        weights={0: 1.0, 1: 2.0},
+    )
+    assert drr.tolist() == [0, 3, 4, 1, 5, 2]
+    with pytest.raises(ValueError, match="non-positive"):
+        policy_order(
+            np.ones(2, np.int64), "fair-drr",
+            tenant=np.array([0, 1]), weights={1: 0.0},
+        )
 
 
 def test_longest_first_bit_exact_and_model_pinned(cfg, small_zipf):
@@ -517,6 +543,50 @@ def test_longest_first_bit_exact_and_model_pinned(cfg, small_zipf):
         engs[policy] = occ
     assert (engs["longest-first"].block_segments
             <= engs["fcfs"].block_segments)
+
+
+def test_service_policies_bit_exact_and_model_pinned(cfg, small_zipf):
+    """The ISSUE-14 admission policies obey the same discipline as
+    longest-first: admission reorder only (dumps bit-exact vs the
+    unscheduled run) and the static model replays the measured
+    counters exactly — including the new deadline outcome and
+    per-tenant live-share counters."""
+    arrays, ref = small_zipf
+    b = 24
+    deadlines = tuple((4, 12, -1)[s % 3] for s in range(b))
+    tenants = tuple(s % 4 for s in range(b))
+    weights = (1.0, 2.0, 4.0, 8.0)
+    for policy in ("deadline-edf", "fair-drr"):
+        eng = PallasEngine(
+            cfg, *arrays,
+            schedule=Schedule(
+                resident=8, policy=policy, deadlines=deadlines,
+                tenants=tenants, tenant_weights=weights,
+            ),
+            **_KW
+        ).run()
+        assert _dumps_match(eng, ref, b)
+        model = simulate(
+            segments_needed(eng._tr_len_np, eng._window),
+            resident=8, block=_KW["block"], groups=1,
+            threshold=eng.schedule.threshold, policy=policy,
+            deadline=np.array(deadlines), tenant=np.array(tenants),
+            tenant_weights=weights,
+        )
+        occ = eng.occupancy
+        assert model.block_segments == occ.block_segments
+        assert model.admissions == occ.admissions
+        assert model.wait_intervals_max == occ.wait_intervals_max
+        assert model.queue_depth_peak == occ.queue_depth_peak
+        # the service counters replay exactly too
+        assert model.deadline_met == occ.deadline_met
+        assert model.deadline_missed == occ.deadline_missed
+        assert (occ.deadline_met + occ.deadline_missed
+                == sum(1 for d in deadlines if d >= 0))
+        assert model.tenant_live == occ.tenant_live
+        assert set(occ.tenant_live) == set(range(4))
+        d = occ.as_dict()
+        assert "deadline_hit_rate" in d and "tenant_share" in d
 
 
 def test_queue_and_wait_counters(cfg, small_zipf):
@@ -553,6 +623,23 @@ def test_occupancy_cli_policy_column():
     assert rc == 0
     assert "longest-first" in table and "fcfs" in table
     assert "wait" in table
+    # legacy policies leave the service columns blank ("-")
+    assert "dlmiss" in table and "maxshr%" in table
+
+
+def test_occupancy_cli_service_policy_columns():
+    from hpa2_tpu.analysis.occupancy import occupancy_table
+
+    table, rc = occupancy_table(
+        32, 48, 8, 8, spreads=(4.0,),
+        policies=("deadline-edf", "fair-drr"),
+    )
+    assert rc == 0
+    assert "deadline-edf" in table and "fair-drr" in table
+    # the deadline/tenant-aware policies fill the service columns with
+    # real numbers: a max tenant share is always > 0
+    rows = [r.split() for r in table.splitlines()[2:] if r.strip()]
+    assert rows and all(float(r[-1]) > 0 for r in rows)
 
 
 # -- heterogeneous workload generator --------------------------------------
@@ -579,12 +666,15 @@ def test_occupancy_cli_table():
     assert "lockstep" in table and "zipf" in table
     assert "barrier" in table and "progrm" in table
     # fused launch accounting: 0 barriers / 1 program on every row
+    # (the last two columns are the ISSUE-14 service columns, "-" for
+    # the legacy policies)
     for row in table.splitlines()[2:]:
-        assert row.split()[-2:] == ["0", "1"]
+        assert row.split()[-4:-2] == ["0", "1"]
+        assert row.split()[-2:] == ["-", "-"]
     # the PR-5 host loop pays one of each per interval
     t5, rc5 = occupancy_table(32, 48, 8, 8, spreads=(4.0,), fused=False)
     assert rc5 == 0
-    barrier, program = t5.splitlines()[2].split()[-2:]
+    barrier, program = t5.splitlines()[2].split()[-4:-2]
     assert barrier == program and int(barrier) > 1
 
 

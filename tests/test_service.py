@@ -1,0 +1,301 @@
+"""Multi-tenant service plane (``hpa2_tpu.service``): the framed wire
+protocol, credit-based admission, and the wire frontend.
+
+The contract under test (PERF.md "Multi-tenant service plane"):
+
+1. **Framing is transport-independent** — frames reassemble
+   identically from any byte segmentation (byte-at-a-time included),
+   and framing violations raise loudly.
+2. **Backpressure is loud** — over-submitting past the connection's
+   admission credits draws a NACK with a reason; nothing hangs and
+   nothing is silently dropped.  Duplicate ids and malformed records
+   NACK too.
+3. **Admission order is the ack transcript** — the ACK ``seq`` is the
+   global admission sequence; the serving loop admits in seq order,
+   so two concurrent clients get a deterministic schedule fixed by
+   their acks, not by reader-thread timing — and the served dumps are
+   byte-identical to a one-shot run of the seq-ordered ensemble.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from hpa2_tpu.config import Semantics, SystemConfig
+from hpa2_tpu.ops.pallas_engine import PallasEngine
+from hpa2_tpu.ops.schedule import Schedule
+from hpa2_tpu.service import (
+    ACK,
+    BYE,
+    DEADLINE_CLASSES,
+    NACK,
+    RESULT,
+    SUBMIT,
+    AdmissionLedger,
+    AdmissionReject,
+    FrameReader,
+    TenantTable,
+    WireClient,
+    WireError,
+    WireJobSource,
+    WireNack,
+    encode_frame,
+    resolve_deadline,
+)
+from hpa2_tpu.serving import job_to_record, serve, synthetic_jobs
+
+ROBUST = Semantics().robust()
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return SystemConfig(num_procs=4, semantics=ROBUST)
+
+
+@pytest.fixture(scope="module")
+def jobs(cfg):
+    return synthetic_jobs(cfg, 8, 24, seed=7, spread=3.0)
+
+
+def _records(jobs, tenant_of=lambda i: ""):
+    recs = []
+    for i, j in enumerate(jobs):
+        r = job_to_record(j)
+        t = tenant_of(i)
+        if t:
+            r["tenant"] = t
+        recs.append(r)
+    return recs
+
+
+# -- framing ----------------------------------------------------------------
+
+
+def test_frame_roundtrip_byte_at_a_time():
+    frames = [
+        (SUBMIT, {"id": "j0", "traces": [[["R", 1]]]}),
+        (ACK, {"id": "j0", "seq": 0, "queue_pos": 0}),
+        (RESULT, {"id": "j0", "latency_s": 0.25}),
+        (BYE, {}),
+    ]
+    blob = b"".join(encode_frame(t, p) for t, p in frames)
+    reader = FrameReader()
+    got = []
+    for i in range(len(blob)):
+        got.extend(reader.feed(blob[i:i + 1]))
+    assert [(f.ftype, f.payload) for f in got] == frames
+    # and in one shot — segmentation never matters
+    got2 = FrameReader().feed(blob)
+    assert [(f.ftype, f.payload) for f in got2] == frames
+
+
+def test_framing_violations_are_loud():
+    with pytest.raises(WireError, match="bad magic"):
+        FrameReader().feed(b"\x00" * 8)
+    good = encode_frame(BYE)
+    bad_version = bytes([good[0], 99]) + good[2:]
+    with pytest.raises(WireError, match="version"):
+        FrameReader().feed(bad_version)
+    bad_type = bytes([good[0], good[1], 200]) + good[3:]
+    with pytest.raises(WireError, match="unknown frame type"):
+        FrameReader().feed(bad_type)
+    with pytest.raises(WireError, match="unknown frame type"):
+        encode_frame(200, {})
+
+
+# -- tenants + deadline classes ---------------------------------------------
+
+
+def test_tenant_table_parse():
+    t = TenantTable.parse("alice:4, bob:1.5")
+    assert t.weight_of("alice") == 4.0
+    assert t.weight_of("bob") == 1.5
+    assert t.weight_of("unlisted") == 1.0
+    assert not TenantTable.parse("")
+    with pytest.raises(ValueError, match="name:weight"):
+        TenantTable.parse("alice")
+    with pytest.raises(ValueError, match="name:weight"):
+        TenantTable.parse("alice:heavy")
+    with pytest.raises(ValueError, match="> 0"):
+        TenantTable.parse("alice:0")
+
+
+def test_resolve_deadline_classes():
+    assert resolve_deadline({}) == -1
+    assert resolve_deadline({"deadline": 5}) == 5
+    for name, dl in DEADLINE_CLASSES.items():
+        assert resolve_deadline({"class": name}) == dl
+    # an explicit deadline always wins over the class
+    assert resolve_deadline({"class": "interactive", "deadline": 99}) == 99
+    with pytest.raises(ValueError, match="unknown deadline class"):
+        resolve_deadline({"class": "platinum"})
+
+
+# -- the admission ledger ---------------------------------------------------
+
+
+def test_ledger_credits_duplicates_and_seq_order():
+    led = AdmissionLedger(credits=2)
+    assert led.register(0) == 2
+    assert led.try_submit(0, {"id": "a", "traces": []}) == (0, 0)
+    assert led.try_submit(0, {"id": "b", "traces": []}) == (1, 1)
+    with pytest.raises(AdmissionReject, match="backpressure"):
+        led.try_submit(0, {"id": "c", "traces": []})
+    with pytest.raises(AdmissionReject, match="'id'"):
+        led.try_submit(0, {"traces": []})
+    with pytest.raises(AdmissionReject, match="exactly one"):
+        led.try_submit(0, {"id": "x"})
+    wave, back = led.take_wave()
+    assert [p.seq for p in wave] == [0, 1]
+    assert back == {0: 2}
+    # credits came back: submitting works again, duplicates never do
+    assert led.try_submit(0, {"id": "c", "traces": []})[0] == 2
+    with pytest.raises(AdmissionReject, match="duplicate"):
+        led.try_submit(0, {"id": "a", "traces": []})
+    assert led.pending == 1
+
+
+# -- credit backpressure over the wire --------------------------------------
+
+
+def test_over_submit_draws_nack_then_drains(cfg, jobs):
+    """The credit guard: with the serving loop NOT yet draining, the
+    (credits+1)-th submit must draw a backpressure NACK — loudly,
+    deterministically, with no hang — and the ack'd jobs still serve
+    to completion afterwards."""
+    recs = _records(jobs)
+    src = WireJobSource(cfg, credits=2)
+    cli = WireClient(*src.address)
+    assert cli.credits == 2
+    acks = [cli.submit(recs[0]), cli.submit(recs[1])]
+    assert [a["seq"] for a in acks] == [0, 1]
+    with pytest.raises(WireNack, match="backpressure"):
+        cli.submit(recs[2], force=True)
+    # and again: backpressure NACKs are repeatable, never a hang
+    with pytest.raises(WireNack, match="backpressure"):
+        cli.submit(recs[2], force=True)
+
+    streamed = []
+    t = threading.Thread(
+        target=lambda: streamed.extend(cli.finish()), daemon=True
+    )
+    t.start()
+    results, stats = serve(
+        cfg, src, backend="pallas", resident=4, window=8, block=4,
+        emit=src.deliver,
+    )
+    t.join(timeout=30)
+    cli.close()
+    assert sorted(r.job_id for r in results) == sorted(
+        r["id"] for r in recs[:2]
+    )
+    assert sorted(r["id"] for r in streamed) == sorted(
+        r["id"] for r in recs[:2]
+    )
+    # the drained wave replenished the client's credits
+    assert cli.credits == 2
+
+
+def test_credit_replenishment_self_clocks(cfg, jobs):
+    """A client holding fewer credits than jobs still pushes the whole
+    feed through: submit() blocks on CREDIT frames as the scheduler
+    drains waves — backpressure clocks the client, drops nothing."""
+    recs = _records(jobs)
+    src = WireJobSource(cfg, credits=2)
+    streamed, acks = [], []
+
+    def client():
+        with WireClient(*src.address) as cli:
+            for r in recs:
+                acks.append(cli.submit(r))
+            streamed.extend(cli.finish())
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    results, _ = serve(
+        cfg, src, backend="pallas", resident=4, window=8, block=4,
+        emit=src.deliver,
+    )
+    t.join(timeout=30)
+    assert [a["seq"] for a in acks] == list(range(len(recs)))
+    assert sorted(r["id"] for r in streamed) == sorted(
+        r["id"] for r in recs
+    )
+    assert len(results) == len(recs)
+
+
+# -- deterministic two-client admission -------------------------------------
+
+
+def test_two_clients_admission_order_is_ack_order(cfg, jobs):
+    """Two clients submitting concurrently: whatever interleaving the
+    reader threads saw, the scheduler admits in ACK-seq order, and the
+    served dumps are byte-identical to a one-shot run of the ensemble
+    ordered by seq."""
+    from hpa2_tpu.ops.pallas_engine import PallasLaneSession
+    from hpa2_tpu.serving.loop import ServingSession
+
+    recs = _records(jobs, tenant_of=lambda i: ("a", "b")[i % 2])
+    half = len(recs) // 2
+    src = WireJobSource(cfg, credits=16)
+    acks = {}
+
+    def client(mine):
+        with WireClient(*src.address) as cli:
+            for r in mine:
+                acks[r["id"]] = cli.submit(r)
+            cli.finish()
+
+    ts = [threading.Thread(target=client, args=(recs[:half],)),
+          threading.Thread(target=client, args=(recs[half:],))]
+    for t in ts:
+        t.start()
+
+    sess = PallasLaneSession(cfg, 4, 8, block=4)
+    drv = ServingSession(sess, src, emit=src.deliver)
+    results, stats = drv.run()
+    for t in ts:
+        t.join(timeout=30)
+
+    assert len(acks) == len(recs)
+    seqs = sorted(acks.values(), key=lambda a: a["seq"])
+    assert [a["seq"] for a in seqs] == list(range(len(recs)))
+    # system ids are assigned in poll order == seq order
+    assert [j.job_id for j in drv._jobs] == [a["id"] for a in seqs]
+
+    # one-shot reference over the seq-ordered ensemble: byte-identical
+    by_id = {j.job_id: j for j in jobs}
+    ordered = [by_id[a["id"]] for a in seqs]
+    ref = PallasEngine(
+        cfg,
+        np.stack([j.tr_op for j in ordered]),
+        np.stack([j.tr_addr for j in ordered]),
+        np.stack([j.tr_val for j in ordered]),
+        np.stack([j.tr_len for j in ordered]),
+        block=4, trace_window=8, snapshots=False,
+        schedule=Schedule(resident=4, fused=False),
+    ).run()
+    got = {r.job_id: r.dumps for r in results}
+    for s, j in enumerate(ordered):
+        assert got[j.job_id] == ref.system_final_dumps(s), j.job_id
+    assert all(c == 1 for c in stats.compile_counts.values())
+
+
+# -- post-ack rejection stays loud ------------------------------------------
+
+
+def test_malformed_trace_body_nacks_after_ack(cfg):
+    """A record that passes the ledger's shape checks but fails job
+    parsing (bad instruction body) must NACK at poll time — a post-ack
+    rejection, never a silent drop."""
+    src = WireJobSource(cfg, credits=4)
+    cli = WireClient(*src.address)
+    bad = {"id": "bad", "traces": [[["Q", 1]]] + [[]] * 3}
+    ack = cli.submit(bad)
+    assert ack["seq"] == 0
+    assert src.poll() == []  # the wave rejected the only record
+    fr = cli._next_frame((NACK,))
+    assert "bad instruction" in fr.payload["reason"]
+    cli.close()
+    src.close()

@@ -18,9 +18,12 @@ The contract under test (PERF.md "Always-on serving"):
    mean rate.
 """
 
+import dataclasses
 import json
 import socket
+import struct
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -226,6 +229,83 @@ def test_sharded_served_matches_one_shot(cfg, jobs):
     _assert_zero_recompiles(stats)
 
 
+def test_admission_policies_serve_byte_identical(cfg, jobs, pallas_ref):
+    """deadline-edf and fair-drr reorder *admission* only: per-job
+    dumps stay byte-identical to the one-shot reference, and the
+    occupancy report grows the deadline / tenant-share columns."""
+    tagged = [
+        dataclasses.replace(
+            j, tenant=("a", "b")[i % 2], deadline=(8, 32, -1)[i % 3]
+        )
+        for i, j in enumerate(jobs)
+    ]
+    with_deadline = sum(1 for j in tagged if j.deadline >= 0)
+
+    results, stats = serve(
+        cfg, ListJobSource(tagged), backend="pallas",
+        policy="deadline-edf", **_SERVE_KW
+    )
+    _assert_served_matches(results, pallas_ref)
+    _assert_zero_recompiles(stats)
+    occ = stats.occupancy
+    assert occ["deadline_met"] + occ["deadline_missed"] == with_deadline
+
+    results, stats = serve(
+        cfg, ListJobSource(tagged), backend="pallas",
+        policy="fair-drr", tenant_weights={"a": 2.0, "b": 1.0},
+        **_SERVE_KW
+    )
+    _assert_served_matches(results, pallas_ref)
+    _assert_zero_recompiles(stats)
+    occ = stats.occupancy
+    assert occ["deadline_met"] + occ["deadline_missed"] == with_deadline
+    share = occ["tenant_share"]
+    assert len(share) == 2
+    assert abs(sum(share.values()) - 1.0) < 1e-6
+
+
+@pytest.mark.virtual_mesh
+def test_node_sharded_served_matches_one_shot(cfg, jobs):
+    """node_shards=2: resident lanes whose NODE planes split across a
+    device mesh; dumps match the one-shot node-sharded scheduled run."""
+    _require_devices(2)
+    from hpa2_tpu.parallel.sharding import NodeShardedPallasEngine
+
+    ref_eng = NodeShardedPallasEngine(
+        cfg, *_batch_arrays(jobs), node_shards=2, block=4,
+        trace_window=8, snapshots=False,
+        schedule=Schedule(resident=4, fused=True),
+    ).run()
+    ref = {j.job_id: ref_eng.system_final_dumps(s)
+           for s, j in enumerate(jobs)}
+    results, stats = serve(
+        cfg, ListJobSource(jobs), backend="pallas-node-sharded",
+        node_shards=2, **_SERVE_KW
+    )
+    _assert_served_matches(results, ref)
+    _assert_zero_recompiles(stats)
+
+
+def test_jax_served_protocol_variant_matches_one_shot(cfg, jobs):
+    """The PR-13 protocol variants survive serving: a moesi config
+    served on the jax backend matches its own one-shot run."""
+    from hpa2_tpu.ops.engine import BatchJaxEngine
+
+    mcfg = dataclasses.replace(cfg, protocol="moesi")
+    ref_eng = BatchJaxEngine(
+        mcfg, [j.batch_traces() for j in jobs],
+        schedule=Schedule(resident=2, fused=False),
+    ).run()
+    ref = {j.job_id: ref_eng.system_final_dumps(s)
+           for s, j in enumerate(jobs)}
+    results, stats = serve(
+        mcfg, ListJobSource(jobs), backend="jax", resident=2,
+        max_trace_len=32, interval=64,
+    )
+    _assert_served_matches(results, ref)
+    _assert_zero_recompiles(stats)
+
+
 # -- the zero-recompile guard ----------------------------------------------
 
 
@@ -335,6 +415,43 @@ def test_socket_source_feeds_serving(cfg, jobs, pallas_ref):
     finally:
         src.close()
     t.join(timeout=5)
+    _assert_served_matches(results, pallas_ref)
+    _assert_zero_recompiles(stats)
+
+
+def test_socket_source_survives_abrupt_disconnect(cfg, jobs, pallas_ref):
+    """A client that RSTs mid-line must not take the source down:
+    every complete record already sent stays queued, the partial line
+    is dropped, and a later connection still finishes the feed."""
+    src = SocketJobSource(cfg)
+    try:
+        first = socket.create_connection(src.address)
+        payload = "".join(
+            json.dumps(job_to_record(j)) + "\n" for j in jobs[:3]
+        )
+        # a partial record with no newline, then an abortive close
+        payload += json.dumps(job_to_record(jobs[3]))[:20]
+        first.sendall(payload.encode())
+        first.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER,
+            struct.pack("ii", 1, 0),
+        )
+        first.close()  # RST, not FIN
+
+        # the three complete records survive; the partial one is gone
+        deadline = time.monotonic() + 10.0
+        while src._queue.qsize() < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert src._queue.qsize() == 3
+
+        lines = [json.dumps(job_to_record(j)) for j in jobs[3:]]
+        lines.append(json.dumps({"eof": True}))
+        with socket.create_connection(src.address) as second:
+            second.sendall(("\n".join(lines) + "\n").encode())
+
+        results, stats = serve(cfg, src, backend="pallas", **_SERVE_KW)
+    finally:
+        src.close()
     _assert_served_matches(results, pallas_ref)
     _assert_zero_recompiles(stats)
 
