@@ -78,6 +78,7 @@ def _bench_config():
         num_procs=8, msg_buffer_size=_CAP,
         semantics=Semantics().robust(),
         elide=not _no_elide(),
+        exchange_mode=_exchange_mode(),
     )
 
 
@@ -100,6 +101,15 @@ def _node_shards() -> int:
         return max(1, int(os.environ.get("HPA2_BENCH_NODE_SHARDS", "1")))
     except ValueError:
         return 1
+
+
+def _exchange_mode() -> str:
+    """Cross-shard transport schedule (``--exchange-mode``): one of
+    ``ops/exchange.EXCHANGE_MODES``; only observable at
+    ``--node-shards`` > 1 (single-shard runs have no exchange)."""
+    return (
+        os.environ.get("HPA2_BENCH_EXCHANGE_MODE", "").strip() or "a2a"
+    )
 
 
 def _packed() -> bool:
@@ -272,14 +282,29 @@ def bench_pallas(config, batch, instrs_per_core, seed=0, data_shards=1,
     }
     exchange = None
     if node_shards > 1:
+        from hpa2_tpu.ops import exchange as xops
+
         xmsgs = eng.cross_shard_msgs
         cycles = max(eng.cycle, 1)
+        plan = xops.make_plan(
+            node_shards, config.exchange_mode, config.exchange_inner
+        )
+        stats = eng.stats()
         exchange = {
             "node_shards": node_shards,
-            "ppermutes_per_cycle": 2 * (node_shards - 1),
+            "exchange_mode": config.exchange_mode,
+            "collectives_per_cycle": xops.plan_collectives(plan),
             "exchange_slots": 5 * (config.num_procs // node_shards),
             "cross_shard_msgs": xmsgs,
             "cross_shard_msgs_per_cycle": round(xmsgs / cycles, 2),
+            "exchange_slot_hwm": stats.get("exchange_slot_hwm", 0),
+            "exchange_bytes_per_cycle": stats.get(
+                "exchange_bytes_per_cycle", 0
+            ),
+            "exchange_multicast_saved": stats.get(
+                "exchange_multicast_saved", 0
+            ),
+            "exchange_combined": stats.get("exchange_combined", 0),
             "msgs_total": eng.messages,
         }
     if schedule is not None:
@@ -1157,6 +1182,22 @@ def main() -> int:
             )
         except (IndexError, ValueError):
             print("usage: bench.py [--node-shards N]", file=sys.stderr)
+            return 2
+    if "--exchange-mode" in sys.argv:
+        # cross-shard transport schedule for --node-shards runs (a2a
+        # default; pairwise is the pre-batched serial-round baseline)
+        i = sys.argv.index("--exchange-mode")
+        try:
+            mode = sys.argv[i + 1]
+            if mode not in ("pairwise", "a2a", "butterfly", "hier"):
+                raise ValueError(mode)
+            os.environ["HPA2_BENCH_EXCHANGE_MODE"] = mode
+        except (IndexError, ValueError):
+            print(
+                "usage: bench.py [--exchange-mode "
+                "pairwise|a2a|butterfly|hier]",
+                file=sys.stderr,
+            )
             return 2
     if "--trace-len-dist" in sys.argv:
         # heterogeneous per-system trace lengths (uniform|zipf over

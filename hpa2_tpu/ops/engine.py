@@ -353,10 +353,20 @@ def engine_stats(st: SimState) -> dict:
         ("forwards", st.n_forwards),
         ("owner_transfers", st.n_owner_xfer),
         ("dir_overflows", st.n_dir_overflow),
+        # cross-shard exchange telemetry (ISSUE-15): identically zero
+        # on single-chip runs, so their schema never changes
+        ("exchange_sent", st.n_exch_sent),
+        ("exchange_multicast_saved", st.n_exch_mc_saved),
+        ("exchange_combined", st.n_exch_combined),
     ):
         val = tot(field)
         if val:
             core[name] = val
+    # the slot high-water mark is a max, not a sum (batched states
+    # report the worst lane)
+    hwm = int(np.max(np.asarray(st.n_exch_hwm)))
+    if hwm:
+        core["exchange_slot_hwm"] = hwm
     return format_stats(core, mc)
 
 

@@ -26,11 +26,33 @@ node-sharded cycle in ``ops/pallas_engine.py``):
    drop, because the sender cannot know whether a dropped entry would
    have been accepted.
 
-3. **Pairwise rounds.** Round ``r`` (1..D-1) ships each shard's buffer
-   to shard ``(i + r) % D`` with one ``ppermute`` (:func:`fwd_perm`);
-   the acceptance feedback returns along :func:`rev_perm`.  A cycle
-   therefore costs exactly ``2*(D-1)`` ppermutes plus ONE stacked psum
-   (counters + quiescence), pinned by the collective-count guards in
+3. **Transport.** How the per-peer buffers actually move is a pluggable
+   *plan* (:func:`make_plan` / :func:`forward` / :func:`feedback`),
+   selected by ``SystemConfig.exchange_mode``:
+
+   * ``pairwise`` — the original schedule: round ``r`` (1..D-1) ships
+     each shard's buffer to ``(i + r) % D`` with one ``ppermute``
+     (:func:`fwd_perm`); feedback returns along :func:`rev_perm`.
+     ``2*(D-1)`` serial collectives per cycle — O(D) depth, the
+     scaling bottleneck ISSUE-15 replaces.
+   * ``a2a`` — all D destination buckets stacked destination-major and
+     moved by ONE batched ``all_to_all`` (feedback: one more).  O(1)
+     collective depth per cycle.
+   * ``butterfly`` — log2(D) stages of stacked ppermutes along an XOR
+     (hypercube) schedule; each stage pairs shard ``i`` with
+     ``i ^ 2^s`` and ships the half of the bucket stack whose
+     destinations differ in bit ``s``.  O(log D) depth for meshes
+     whose ``all_to_all`` lowering is slow.
+   * ``hier`` — two-tier exchange for meshes that factor as
+     ``outer x inner`` (cf. create_hybrid_device_mesh): inner-tier
+     rounds first, same-directory READ_REQUESTs are counted as
+     combinable at the tier boundary (``exchange_combined``), then
+     outer-tier rounds ship only tier-crossing traffic —
+     ``2*(Di + Do - 2)`` collectives.
+
+   A cycle costs the plan's collectives plus ONE stacked psum
+   (counters + quiescence) and one stacked pmax (slot high-water mark
+   + overflow diagnostics), pinned by the collective-count guards in
    tests.
 
 4. **Ordered-rank acceptance.** The receiver sees one *local* block
@@ -50,7 +72,7 @@ cycle program.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -197,3 +219,428 @@ def ordered_rank(
         rank_a,
         jnp.where(vb != 0, rank_b, RANK_INVALID),
     )
+
+
+# ======================================================================
+# Transport plans (ISSUE-15): how the destination buckets move.
+#
+# ``forward`` buckets a [R, J0, ...] payload by destination shard
+# (``dest_fn(block, peer) -> bool [J, ...]`` must work on *any* payload
+# block, because the hier relays re-bucket received entries) and
+# returns the received entry blocks plus one traced origin shard id per
+# block.  Delivery correctness only needs the (origin block, in-block
+# order) pair to be *bijective* — every (origin, destination) pair has
+# exactly one route — because :func:`ordered_rank` reconstructs the
+# global order from the origin ids; the physical arrival order is
+# plan-dependent and irrelevant.
+#
+# ``feedback`` routes additive per-entry acceptance rows back along the
+# exact reverse schedule and scatters them onto the sender's candidate
+# axis through the saved compaction placements.  Feedback rows are
+# SUMS (bit words from disjoint receivers never collide), so the hier
+# relays can simply add the contributions arriving from different
+# outer rounds before shipping them down the inner tier.
+# ======================================================================
+
+EXCHANGE_MODES = ("pairwise", "a2a", "butterfly", "hier")
+
+
+class Plan(NamedTuple):
+    """Static description of one exchange schedule."""
+
+    mode: str
+    d: int
+    di: int  # hier inner-tier size (1 for flat modes)
+    do: int  # hier outer-tier size (== d for flat modes)
+
+
+def _auto_inner(d: int) -> int:
+    """Largest divisor of ``d`` not above sqrt(d) (1 when d is prime)."""
+    best = 1
+    f = 1
+    while f * f <= d:
+        if d % f == 0:
+            best = f
+        f += 1
+    return best
+
+
+def make_plan(d: int, mode: str = "pairwise", inner: int = 0) -> Plan:
+    """Validate + normalize an exchange plan for ``d`` node shards.
+
+    ``inner`` only matters for ``hier``: the inner-tier size (0 = auto,
+    the largest divisor of ``d`` <= sqrt(d)).  ``butterfly`` needs a
+    power-of-two shard count; ``a2a``/``pairwise`` work for any ``d``.
+    """
+    if mode not in EXCHANGE_MODES:
+        raise ValueError(
+            f"unknown exchange_mode {mode!r}; expected one of "
+            f"{EXCHANGE_MODES}"
+        )
+    if d < 1:
+        raise ValueError(f"node shard count {d} must be >= 1")
+    if mode == "butterfly" and (d & (d - 1)) != 0:
+        raise ValueError(
+            f"exchange_mode='butterfly' needs a power-of-two shard "
+            f"count, got {d}; use 'a2a' (any D) or 'hier'"
+        )
+    di, do = 1, d
+    if mode == "hier":
+        di = inner or _auto_inner(d)
+        if di < 1 or d % di != 0:
+            raise ValueError(
+                f"exchange_inner={inner} does not divide node "
+                f"shards={d}"
+            )
+        do = d // di
+    return Plan(mode=mode, d=d, di=di, do=do)
+
+
+def plan_collectives(plan: Plan) -> dict:
+    """Per-cycle cross-shard collective budget of a plan (forward +
+    feedback; the stacked counter psum/pmax are extra and mode-free).
+    Keys: ``ppermute``, ``all_to_all``."""
+    d = plan.d
+    if d <= 1:
+        return {"ppermute": 0, "all_to_all": 0}
+    if plan.mode == "pairwise":
+        return {"ppermute": 2 * (d - 1), "all_to_all": 0}
+    if plan.mode == "a2a":
+        return {"ppermute": 0, "all_to_all": 2}
+    if plan.mode == "butterfly":
+        return {"ppermute": 2 * (d.bit_length() - 1), "all_to_all": 0}
+    return {"ppermute": 2 * (plan.di + plan.do - 2), "all_to_all": 0}
+
+
+def _trail_zeros(payload) -> jnp.ndarray:
+    return jnp.zeros(payload.shape[2:], dtype=I32)
+
+
+def _source_stats(d, me, payload, dest_fn, fan_fn):
+    """Mode-independent source-side telemetry over the D-1 peer
+    buckets: total entries shipped, per-bucket demand high-water mark,
+    and the unicast slots a mask-less INV fan-out would have cost
+    (``fan - 1`` per shipped multicast entry)."""
+    sent = _trail_zeros(payload)
+    hwm = _trail_zeros(payload)
+    mc = _trail_zeros(payload)
+    for rnd in range(1, d):
+        peer = (me + rnd) % d
+        mask = dest_fn(payload, peer)
+        dcount = jnp.sum(mask.astype(I32), axis=0)
+        sent = sent + dcount
+        hwm = jnp.maximum(hwm, dcount)
+        if fan_fn is not None:
+            fan = fan_fn(payload, peer)
+            mc = mc + jnp.sum(
+                jnp.where(mask, jnp.maximum(fan - 1, 0), 0), axis=0
+            )
+    return sent, hwm, mc
+
+
+def _ovf_note(fs: dict, ovf, demand, src, dst) -> None:
+    """Fold one compaction's overflow into the running stats: count,
+    and a packed max-demand event word ``demand<<16 | src<<8 | dst``
+    (field-clipped; the max over shards/cycles therefore names the
+    worst offender)."""
+    fs["overflow"] = fs["overflow"] + ovf
+    word = (
+        (jnp.minimum(demand, 0xFFFF) << 16)
+        | ((src % 256) << 8)
+        | (dst % 256)
+    )
+    fs["ovf_diag"] = jnp.maximum(
+        fs["ovf_diag"], jnp.where(ovf > 0, word, 0)
+    )
+
+
+def _compact_to(fs: dict, mask, block, k: int, src, dst):
+    """compact + overflow bookkeeping (statically free when ``k`` can
+    hold every entry of the block)."""
+    buf, sel, ovf = compact(mask, block, k)
+    if k < int(block.shape[1]):
+        demand = jnp.sum(mask.astype(I32), axis=0)
+        fs["hwm"] = jnp.maximum(fs["hwm"], demand)
+        _ovf_note(fs, ovf, demand, src, dst)
+    return buf, sel
+
+
+def forward(
+    plan: Plan,
+    axis_name,
+    me,
+    payload,
+    dest_fn: Callable,
+    k: int,
+    fan_fn: Optional[Callable] = None,
+    ckey_row: Optional[int] = None,
+    nkeys: int = 0,
+):
+    """Run the plan's forward exchange.
+
+    ``payload``: [R, J0, ...] candidate rows; ``dest_fn(block, peer)``
+    -> bool [J, ...] destination mask (``peer`` may be traced);
+    ``k``: entries per exchange buffer; ``fan_fn(block, peer)`` -> i32
+    [J, ...] receiver count of an entry within ``peer`` (for the
+    multicast-savings counter); ``ckey_row``/``nkeys``: payload row
+    holding the combining key (0 = not combinable, else key+1) and the
+    key-space size — only read by ``hier`` relays.
+
+    Returns ``(bufs, origins, ctx, fstats)``: the received [R, k, ...]
+    entry blocks, one origin shard id per block with the local block's
+    ``me`` prepended (feed both to :func:`ordered_rank`), the opaque
+    feedback context, and the telemetry dict (``sent``, ``hwm``,
+    ``mc_saved``, ``combined``, ``overflow``, ``ovf_diag`` — i32 with
+    the payload's trailing shape)."""
+    d = plan.d
+    z = _trail_zeros(payload)
+    fs = {
+        "sent": z, "hwm": z, "mc_saved": z, "combined": z,
+        "overflow": z, "ovf_diag": z,
+    }
+    if d <= 1:
+        return [], [me], (plan.mode, []), fs
+    fs["sent"], fs["hwm"], fs["mc_saved"] = _source_stats(
+        d, me, payload, dest_fn, fan_fn
+    )
+    if plan.mode == "pairwise":
+        bufs, sels, origins = [], [], [me]
+        for rnd in range(1, d):
+            peer = (me + rnd) % d
+            buf, sel = _compact_to(
+                fs, dest_fn(payload, peer), payload, k, me, peer
+            )
+            bufs.append(
+                jax.lax.ppermute(buf, axis_name, fwd_perm(d, rnd))
+            )
+            sels.append(sel)
+            origins.append(origin_of_round(me, d, rnd))
+        return bufs, origins, ("pairwise", sels), fs
+
+    if plan.mode == "a2a":
+        # one destination-major bucket stack, one tiled all_to_all:
+        # received block b arrives from source shard b.  The self block
+        # is zero-filled; rolling the received stack by -(me+1) parks
+        # it at static position d-1, so the receiver pipeline (rank +
+        # delivery scatters) only ever processes d-1 real blocks — the
+        # same count as pairwise
+        outs, sels = [], []
+        for p in range(d):
+            mask = dest_fn(payload, p) & (me != p)
+            buf, sel = _compact_to(fs, mask, payload, k, me, p)
+            outs.append(buf)
+            sels.append(sel)
+        recv = jax.lax.all_to_all(
+            jnp.stack(outs, axis=0), axis_name,
+            split_axis=0, concat_axis=0, tiled=True,
+        )
+        recv = jnp.roll(recv, -(me + 1), axis=0)
+        bufs = [recv[b] for b in range(d - 1)]
+        origins = [me] + [(me + 1 + b) % d for b in range(d - 1)]
+        return bufs, origins, ("a2a", sels), fs
+
+    if plan.mode == "butterfly":
+        # XOR fold: bucket rel holds entries for shard me ^ rel; stage
+        # s ships (stacked, ONE ppermute) every odd cell to partner
+        # i ^ 2^s and concatenates what arrives — after log2(D) stages
+        # the surviving cell holds D blocks with block b from source
+        # me ^ b (self-inverse routing: each hop fixes one dest bit)
+        stages = d.bit_length() - 1
+        # rel-0 is the self bucket: never shipped, identically zero —
+        # seed it without a compaction and drop it from the delivery
+        # set at the end, so the receiver pipeline processes d-1 real
+        # blocks like every other mode
+        zero_block = jnp.zeros(
+            (payload.shape[0], k) + tuple(payload.shape[2:]),
+            dtype=payload.dtype,
+        )
+        blocks, sels = [zero_block], [None]
+        for rel in range(1, d):
+            buf, sel = _compact_to(
+                fs, dest_fn(payload, me ^ rel), payload, k, me, me ^ rel
+            )
+            blocks.append(buf)
+            sels.append(sel)
+        cells = [[b] for b in blocks]
+        for s in range(stages):
+            perm = [(i, i ^ (1 << s)) for i in range(d)]
+            ship = jnp.stack(
+                [
+                    jnp.stack(cells[2 * t + 1])
+                    for t in range(len(cells) // 2)
+                ]
+            )
+            got = jax.lax.ppermute(ship, axis_name, perm)
+            cells = [
+                cells[2 * t] + [got[t, b] for b in range(1 << s)]
+                for t in range(len(cells) // 2)
+            ]
+        bufs = cells[0][1:]
+        origins = [me] + [me ^ b for b in range(1, d)]
+        return bufs, origins, ("butterfly", sels), fs
+
+    # hier: route (origin -> relay -> dest) with the relay in the
+    # origin's outer group at the destination's inner index.  Inner
+    # round r ships everything bound for inner index (me_i + r); the
+    # relay pool (local payload + the Di-1 inner arrivals) is then
+    # re-bucketed per outer round, so DCN-class outer links carry each
+    # entry exactly once per destination group.
+    di, do = plan.di, plan.do
+    me_i = me % di
+    me_o = me // di
+    j0 = int(payload.shape[1])
+
+    def union_inner(block, ti):
+        m = None
+        for o in range(do):
+            mo = dest_fn(block, o * di + ti)
+            m = mo if m is None else (m | mo)
+        return m
+
+    inner_sels, bufs, origins = [], [], [me]
+    for r in range(1, di):
+        ti = (me_i + r) % di
+        buf, sel = _compact_to(
+            fs, union_inner(payload, ti), payload, k, me, me_o * di + ti
+        )
+        perm = [
+            (o * di + i, o * di + (i + r) % di)
+            for o in range(do) for i in range(di)
+        ]
+        bufs.append(jax.lax.ppermute(buf, axis_name, perm))
+        inner_sels.append(sel)
+        origins.append(me_o * di + (me_i - r) % di)
+    pool = [payload] + list(bufs)  # entries bound for inner index me_i
+
+    outer_sels = []
+    for r in range(1, do):
+        tgt = ((me_o + r) % do) * di + me_i
+        subs, sels_r = [], []
+        cnt = None
+        for q, blk in enumerate(pool):
+            mq = dest_fn(blk, tgt)
+            sub, sq = _compact_to(fs, mq, blk, k, me, tgt)
+            subs.append(sub)
+            sels_r.append(sq)
+            if ckey_row is not None and nkeys > 0:
+                # tier-boundary combining (modeled, PR-11 style: the
+                # duplicates still ship so delivery stays bit-exact;
+                # the counter reports what an in-network combiner
+                # would have merged on the outer links)
+                key = blk[ckey_row]
+                kk = jnp.arange(1, nkeys + 1, dtype=I32).reshape(
+                    (nkeys,) + (1,) * key.ndim
+                )
+                hot = jnp.where(
+                    (key[None] == kk) & mq[None], 1, 0
+                )
+                c = jnp.sum(hot, axis=1)
+                cnt = c if cnt is None else cnt + c
+        if cnt is not None:
+            fs["combined"] = fs["combined"] + jnp.sum(
+                jnp.maximum(cnt - 1, 0), axis=0
+            )
+        perm = [
+            (o * di + i, ((o + r) % do) * di + i)
+            for o in range(do) for i in range(di)
+        ]
+        got = jax.lax.ppermute(jnp.stack(subs), axis_name, perm)
+        og = (me_o - r) % do
+        for q in range(di):
+            bufs.append(got[q])
+            origins.append(
+                og * di + (me_i if q == 0 else (me_i - q) % di)
+            )
+        outer_sels.append(sels_r)
+    return bufs, origins, ("hier", (inner_sels, outer_sels, plan)), fs
+
+
+def feedback(plan: Plan, axis_name, fb_blocks: List, ctx):
+    """Route additive acceptance rows back to the senders.
+
+    ``fb_blocks``: one [R2, k, ...] feedback slice per received block,
+    in :func:`forward`'s block order.  Returns the [R2, J0, ...]
+    contribution to the *local* candidate axis (add it to the local
+    feedback slice)."""
+    mode, saved = ctx
+    d = plan.d
+    if d <= 1 or not fb_blocks:
+        return 0
+    if mode == "pairwise":
+        acc = None
+        for i, (fb, sel) in enumerate(zip(fb_blocks, saved)):
+            fbp = jax.lax.ppermute(fb, axis_name, rev_perm(d, i + 1))
+            c = uncompact(fbp, sel)
+            acc = c if acc is None else acc + c
+        return acc
+    if mode == "a2a":
+        # undo forward's roll: fb block r answers the sender at
+        # (me+1+r) % d, so rolling by +(me+1) puts each chunk at its
+        # destination-major position (the zero pad lands on self)
+        me = jax.lax.axis_index(axis_name)
+        pad = jnp.zeros_like(fb_blocks[0])
+        out = jnp.roll(
+            jnp.stack(list(fb_blocks) + [pad], axis=0), me + 1, axis=0
+        )
+        ret = jax.lax.all_to_all(
+            out, axis_name, split_axis=0, concat_axis=0, tiled=True,
+        )
+        acc = None
+        for b in range(d):
+            c = uncompact(ret[b], saved[b])
+            acc = c if acc is None else acc + c
+        return acc
+    if mode == "butterfly":
+        stages = d.bit_length() - 1
+        # forward dropped the inert rel-0 block; restore its slot so
+        # the reverse fold sees the full d-cell structure
+        cells = [[jnp.zeros_like(fb_blocks[0])] + list(fb_blocks)]
+        for s in reversed(range(stages)):
+            half = 1 << s
+            perm = [(i, i ^ (1 << s)) for i in range(d)]
+            ship = jnp.stack([jnp.stack(c[half:]) for c in cells])
+            got = jax.lax.ppermute(ship, axis_name, perm)
+            nxt = []
+            for t, c in enumerate(cells):
+                nxt.append(c[:half])
+                nxt.append([got[t, b] for b in range(half)])
+            cells = nxt
+        acc = None
+        for rel in range(1, d):
+            c = uncompact(cells[rel][0], saved[rel])
+            acc = c if acc is None else acc + c
+        return acc
+    # hier: reverse the outer rounds first (scattering relay feedback
+    # onto the pool blocks — contributions for the same inner buffer
+    # from different outer rounds ADD, matching the single-route
+    # delivery), then the inner rounds
+    inner_sels, outer_sels, p = saved
+    di, do = p.di, p.do
+    nb_inner = di - 1
+    fb_inner = list(fb_blocks[:nb_inner])
+    local_acc = None
+    idx = nb_inner
+    for ri, r in enumerate(range(1, do)):
+        perm = [
+            (o * di + i, ((o - r) % do) * di + i)
+            for o in range(do) for i in range(di)
+        ]
+        ret = jax.lax.ppermute(
+            jnp.stack(fb_blocks[idx : idx + di]), axis_name, perm
+        )
+        idx += di
+        for q in range(di):
+            c = uncompact(ret[q], outer_sels[ri][q])
+            if q == 0:
+                local_acc = c if local_acc is None else local_acc + c
+            else:
+                fb_inner[q - 1] = fb_inner[q - 1] + c
+    for ri, r in enumerate(range(1, di)):
+        perm = [
+            (o * di + i, o * di + (i - r) % di)
+            for o in range(do) for i in range(di)
+        ]
+        ret = jax.lax.ppermute(fb_inner[ri], axis_name, perm)
+        c = uncompact(ret, inner_sels[ri])
+        local_acc = c if local_acc is None else local_acc + c
+    return local_acc

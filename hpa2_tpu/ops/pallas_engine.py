@@ -558,13 +558,18 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
 
     ``axis_name``/``shards``: node-sharded SPMD mode.  The body sees
     the local block of ``num_procs // shards`` node rows and phase C
-    runs the targeted cross-shard exchange (``ops/exchange.py``) —
-    2*(shards-1) ppermutes plus ONE stacked psum per cycle.  This mode
-    is plain XLA under ``shard_map`` (collectives cannot run inside a
-    Mosaic kernel) and carries three transient [1, bb] rows in the
-    state dict: ``activeg`` (psum'd global activity, the quiescence
-    signal), ``xmsgs`` (cumulative cross-shard messages) and
-    ``exchov`` (sticky exchange-overflow flag).  ``exchange_slots``
+    runs the targeted cross-shard exchange (``ops/exchange.py``) on
+    the ``config.exchange_mode`` collective schedule (see
+    ``exchange.plan_collectives``) plus ONE stacked psum and ONE
+    stacked pmax per cycle.  This mode is plain XLA under
+    ``shard_map`` (collectives cannot run inside a Mosaic kernel) and
+    carries transient [1, bb] rows in the state dict: ``activeg``
+    (psum'd global activity, the quiescence signal), ``xmsgs``
+    (cumulative cross-shard messages), ``exchov`` (sticky
+    exchange-overflow flag), ``exchhw``/``exchmc``/``exchcb``
+    (exchange slot high-water mark, multicast and combining savings)
+    and ``exchdg``/``exchdc`` (packed worst-overflow diagnostics:
+    demand/shard-pair and demand/cycle words).  ``exchange_slots``
     caps the per-peer buffer (default: the capacity-exact
     ``5 * n_local``, which cannot overflow); a tighter cap trades ICI
     bytes for a loud overflow status.
@@ -599,6 +604,13 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
         raise ValueError(
             f"exchange_slots={exchange_slots} out of range [1, {5 * nl}]"
         )
+    xplan = (
+        exchange.make_plan(
+            shards, config.exchange_mode, config.exchange_inner
+        )
+        if sharded
+        else None
+    )
     layout, W = _mb_layout(config)
     recv_packed = "recv" in layout
     split = _split_mode(config)
@@ -1398,40 +1410,77 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
                 ],
                 axis=0,
             )
+            # tier-boundary combining key (hier relays only): addr+1
+            # for READ requests, 0 = not combinable
+            ckey5 = [
+                jnp.where(
+                    (slots5[k]["recv"] >= 0)
+                    & (dec(words5[k], "type")
+                       == int(MsgType.READ_REQUEST)),
+                    dec(words5[k], "addr") + 1,
+                    0,
+                )
+                for k in range(_NSLOTS)
+            ]
+            ckey_col = jnp.concatenate(
+                [
+                    interleave([ckey5[0], ckey5[1], ckey5[2]]),
+                    interleave([ckey5[3], ckey5[4]]),
+                ],
+                axis=0,
+            )
             j0 = 5 * nl
             payload = jnp.stack(
-                cand_words + mask_words + [recv_p1, isa_col], axis=0
-            )                                  # [W + SW + 2, J0, bb]
-            xmsg_loc = jnp.zeros((1, bb), I32)
-            exch_over = jnp.zeros((1, bb), I32)
-            bufs, sels = [], []
-            origins = [me]
-            for rnd in range(1, shards):
-                peer = (me + rnd) % shards
+                cand_words + mask_words + [recv_p1, isa_col, ckey_col],
+                axis=0,
+            )                                  # [W + SW + 3, J0, bb]
+
+            def dest_fn(blk, peer):
                 lo = peer * nl
-                dest_pt = (recv_p1 >= lo + 1) & (recv_p1 < lo + nl + 1)
+                recv = blk[W + SW]
+                pt = (recv >= lo + 1) & (recv < lo + nl + 1)
                 rm_i = jax.lax.bitcast_convert_type(
                     exchange.range_mask_words(lo, lo + nl, SW, bpw), I32
                 )
-                mhit = (mask_words[0] & rm_i[0]) != 0
+                mhit = (blk[W] & rm_i[0]) != 0
                 for sw in range(1, SW):
-                    mhit = mhit | ((mask_words[sw] & rm_i[sw]) != 0)
-                dest = dest_pt | mhit
-                buf, sel, ovf = exchange.compact(dest, payload, k_slots)
-                bufs.append(
-                    jax.lax.ppermute(
-                        buf, axis_name, exchange.fwd_perm(shards, rnd)
-                    )
+                    mhit = mhit | ((blk[W + sw] & rm_i[sw]) != 0)
+                return pt | mhit
+
+            def fan_fn(blk, peer):
+                # receivers within shard ``peer``: fan-mask popcount
+                # for INV entries (>= 1 whenever shipped), 1 for point
+                # sends (popcount 0 on a point entry's zero mask)
+                lo = peer * nl
+                rm_i = jax.lax.bitcast_convert_type(
+                    exchange.range_mask_words(lo, lo + nl, SW, bpw), I32
                 )
-                sels.append(sel)
-                origins.append(exchange.origin_of_round(me, shards, rnd))
-                xmsg_loc = xmsg_loc + jnp.sum(
-                    dest.astype(I32), axis=0, keepdims=True
-                )
-                if k_slots < j0:  # statically elided when capacity-exact
-                    exch_over = jnp.maximum(
-                        exch_over, jnp.minimum(ovf, 1)[None, :]
-                    )
+                pop = _popcount(blk[W] & rm_i[0])
+                for sw in range(1, SW):
+                    pop = pop + _popcount(blk[W + sw] & rm_i[sw])
+                return jnp.maximum(pop, 1)
+
+            bufs, origins, xctx, xfs = exchange.forward(
+                xplan, axis_name, me, payload, dest_fn, k_slots,
+                fan_fn=fan_fn, ckey_row=W + SW + 2, nkeys=n * m,
+            )
+            nb = len(bufs)
+            xmsg_loc = xfs["sent"][None, :]
+            exch_over = jnp.minimum(xfs["overflow"], 1)[None, :]
+            xhw_loc = xfs["hwm"][None, :]
+            xmc_loc = xfs["mc_saved"][None, :]
+            xcb_loc = xfs["combined"][None, :]
+            # overflow diagnostics: the packed worst-offender word
+            # (demand<<16 | src<<8 | dst) plus a companion word keyed
+            # by the same demand with the lane cycle in the low half,
+            # so one pmax selects a consistent (shard pair, cycle) pair
+            xdg_loc = xfs["ovf_diag"][None, :]
+            xdc_loc = jnp.where(
+                xdg_loc > 0,
+                (xdg_loc & ~0xFFFF)
+                | (s["scalars"][_SC_CYCLE][None, :] & 0xFFFF),
+                0,
+            )
 
             def cat(i, local_row):
                 return jnp.concatenate(
@@ -1443,7 +1492,7 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             all_recv = cat(W + SW, recv_p1)
             all_isa = cat(W + SW + 1, isa_col)
             bounds = [0, j0] + [
-                j0 + (i + 1) * k_slots for i in range(shards - 1)
+                j0 + (i + 1) * k_slots for i in range(nb)
             ]
             # validity per (receiver row, entry): point match on the
             # shifted recv, or a fan-mask bit probe at the receiver's
@@ -1487,9 +1536,9 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
                 for w in range(W)
             ]
             # acceptance feedback to the senders: per-entry accepted
-            # count + accepted-receiver bit words ride one reverse
-            # ppermute per round and scatter back onto the local
-            # candidate axis via the saved compaction placement
+            # count + accepted-receiver bit words ride the plan's
+            # reverse collective schedule and scatter back onto the
+            # local candidate axis via the saved compaction placement
             acc_e = jnp.sum(acc_i3, axis=0)    # [J, bb]
             fb_bits = []
             for sw in range(SW):
@@ -1506,13 +1555,13 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
                     )
                 )                              # [J, bb]
             fbrows = jnp.stack([acc_e] + fb_bits, axis=0)
-            acc_tot = fbrows[:, :j0]
-            for i, sel in enumerate(sels):
-                fb = jax.lax.ppermute(
-                    fbrows[:, bounds[i + 1]:bounds[i + 2]],
-                    axis_name, exchange.rev_perm(shards, i + 1),
-                )
-                acc_tot = acc_tot + exchange.uncompact(fb, sel)
+            fb_blocks = [
+                fbrows[:, bounds[i + 1]:bounds[i + 2]]
+                for i in range(nb)
+            ]
+            acc_tot = fbrows[:, :j0] + exchange.feedback(
+                xplan, axis_name, fb_blocks, xctx
+            )
             acc_j = acc_tot[0]                 # [J0, bb] global accepts
             dcount = jnp.concatenate(
                 [
@@ -1682,13 +1731,16 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
             ]
             mc_g = mc
         else:
-            # ONE stacked psum carries every cross-shard reduction of
-            # the cycle: end-of-cycle global activity (next cycle's
-            # lane-active gate — end state at cycle t IS start state at
-            # t+1), cross-shard message count, exchange overflow,
-            # mailbox overflow, and the 8 + NTYPES counter rows.  The
-            # collective-count guard pins the loop to the 2*(D-1)
-            # ppermutes plus exactly this psum.
+            # ONE stacked psum carries every cross-shard summed
+            # reduction of the cycle: end-of-cycle global activity
+            # (next cycle's lane-active gate — end state at cycle t IS
+            # start state at t+1), cross-shard message count, exchange
+            # overflow, mailbox overflow, the 8 + NTYPES counter rows,
+            # and the multicast/combining savings.  A second stacked
+            # pmax replicates the max-telemetry (slot high-water mark
+            # and the packed overflow diagnostics).  The
+            # collective-count guard pins the loop to the plan's
+            # exchange collectives plus exactly this psum + pmax.
             end_active = (
                 jnp.sum(jnp.maximum(tr_len - pc, 0), axis=0,
                         keepdims=True)
@@ -1704,12 +1756,16 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
                         row(elig), md, row(is_rd & hit), row(rm),
                         row(is_wr & hit), row(wm),
                         row(ev_replyrd | ev_flush | ev_issue),
-                        row(inv_applied), mc,
+                        row(inv_applied), mc, xmc_loc, xcb_loc,
                     ],
                     axis=0,
                 ),
                 axis_name,
-            )                              # [12 + NTYPES, B] replicated
+            )                              # [14 + NTYPES, B] replicated
+            pm = jax.lax.pmax(
+                jnp.concatenate([xhw_loc, xdg_loc, xdc_loc], axis=0),
+                axis_name,
+            )                              # [3, B] replicated
             upd = [
                 # previous cycle's psum'd end-activity == this cycle's
                 # start activity (the runner seeds activeg with one
@@ -1725,14 +1781,20 @@ def build_cycle(config: SystemConfig, bb: int, snapshots: bool = True,
                 (_SC_EV, g[10:11]),
                 (_SC_INV, g[11:12]),
             ]
-            mc_g = g[12:]
+            mc_g = g[12:12 + _NTYPES]
             # transient rows threaded by the node-sharded runner (not
             # part of state_shapes): global activity for the quiescence
             # gate, cumulative cross-shard messages, sticky exchange
-            # overflow
+            # overflow, and the exchange telemetry (slot high-water
+            # mark, multicast/combining savings, overflow diagnostics)
             out["activeg"] = g[0:1]
             out["xmsgs"] = s["xmsgs"] + g[1:2]
             out["exchov"] = jnp.maximum(s["exchov"], g[2:3])
+            out["exchmc"] = s["exchmc"] + g[12 + _NTYPES:13 + _NTYPES]
+            out["exchcb"] = s["exchcb"] + g[13 + _NTYPES:14 + _NTYPES]
+            out["exchhw"] = jnp.maximum(s["exchhw"], pm[0:1])
+            out["exchdg"] = jnp.maximum(s["exchdg"], pm[1:2])
+            out["exchdc"] = jnp.maximum(s["exchdc"], pm[2:3])
         iota_sc = jax.lax.broadcasted_iota(I32, (_NSCALAR, bb), 0)
         inc = jnp.zeros((_NSCALAR, bb), I32)
         for rid, val in upd:

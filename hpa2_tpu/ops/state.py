@@ -171,6 +171,12 @@ class SimState(NamedTuple):
     n_forwards: jnp.ndarray      # cache-to-cache fills w/o a home copy
     n_owner_xfer: jnp.ndarray    # owner/forwarder pointer migrations
     n_dir_overflow: jnp.ndarray  # limited-pointer broadcast fallbacks
+    # cross-shard exchange telemetry (ISSUE-15; scalars, zero off the
+    # node-sharded path).  hwm is a running max, the rest accumulate.
+    n_exch_sent: jnp.ndarray      # entries shipped across node shards
+    n_exch_hwm: jnp.ndarray       # per-bucket slot demand high-water
+    n_exch_mc_saved: jnp.ndarray  # INV unicast slots saved by masks
+    n_exch_combined: jnp.ndarray  # same-addr reads combinable at tier
 
 
 def init_state_batched(
@@ -273,6 +279,10 @@ def init_state_batched(
         n_forwards=zeros((b,), I32),
         n_owner_xfer=zeros((b,), I32),
         n_dir_overflow=zeros((b,), I32),
+        n_exch_sent=zeros((b,), I32),
+        n_exch_hwm=zeros((b,), I32),
+        n_exch_mc_saved=zeros((b,), I32),
+        n_exch_combined=zeros((b,), I32),
     )
 
 
@@ -386,4 +396,8 @@ def init_state(
         n_forwards=jnp.zeros((), dtype=I32),
         n_owner_xfer=jnp.zeros((), dtype=I32),
         n_dir_overflow=jnp.zeros((), dtype=I32),
+        n_exch_sent=jnp.zeros((), dtype=I32),
+        n_exch_hwm=jnp.zeros((), dtype=I32),
+        n_exch_mc_saved=jnp.zeros((), dtype=I32),
+        n_exch_combined=jnp.zeros((), dtype=I32),
     )

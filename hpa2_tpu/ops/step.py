@@ -183,9 +183,10 @@ def build_step(
     per-shard SPMD body for ``jax.shard_map``: every node-leading array
     it sees is the local block of ``num_procs // shards`` nodes, and
     phase C moves only the candidates that actually cross shards via
-    the targeted ``ppermute`` exchange (``ops/exchange.py``) — exactly
-    ``2*(shards-1)`` ppermutes plus one stacked counter ``psum`` per
-    cycle.
+    the targeted exchange (``ops/exchange.py``).  The collective
+    schedule follows ``config.exchange_mode`` — see
+    ``exchange.plan_collectives`` — plus one stacked counter ``psum``
+    and one stacked telemetry ``pmax`` per cycle.
     """
     n = config.num_procs
     c = config.cache_size
@@ -240,6 +241,13 @@ def build_step(
     drop_p = float(fault.drop)
     n_local = n // shards
     local_ids = jnp.arange(n_local, dtype=I32)
+    xplan = (
+        exchange.make_plan(
+            shards, config.exchange_mode, config.exchange_inner
+        )
+        if axis_name is not None and shards > 1
+        else None
+    )
 
     # -- interconnect topology (static: ideal builds add zero ops and
     # keep the exact pre-topology mb_data layout / op counts) ---------
@@ -951,15 +959,19 @@ def build_step(
             comb = comb_loc
             bounds = [0, j0]
             origins = [jnp.zeros((), dtype=I32)]
-            sels = []
+            nb = 0
+            xctx = None
+            xstats = None
         else:
             # targeted exchange (ops/exchange.py): bucket candidates by
             # destination shard (point sends by recv // n_local, INV
             # multicasts by which shards hold fan-mask bits), compact
             # each bucket into a capacity-exact K = 5*n_local buffer
-            # (overflow-free by construction) and ship it with one
-            # ppermute per round — the old tiled all_gather moved the
-            # whole 5N grid every cycle instead.
+            # (overflow-free by construction) and ship it on the
+            # configured collective schedule (exchange_mode: pairwise
+            # ppermute rounds, one batched all_to_all, a log-D
+            # butterfly, or the two-tier hierarchy) — the old tiled
+            # all_gather moved the whole 5N grid every cycle instead.
             me = jax.lax.axis_index(axis_name).astype(I32)
             payload = jnp.stack(
                 [
@@ -971,30 +983,60 @@ def build_step(
                 + [
                     jax.lax.bitcast_convert_type(comb_loc[:, wi], I32)
                     for wi in range(w)
+                ]
+                + [
+                    # tier-boundary combining key: addr+1 for READ
+                    # requests, 0 = not combinable (only hier reads it)
+                    jnp.where(
+                        pv_loc
+                        & (floc["type"] == int(MsgType.READ_REQUEST)),
+                        floc["addr"] + 1,
+                        0,
+                    )
                 ],
                 axis=0,
-            )  # [10 + W, J0]
+            )  # [10 + W + 1, J0]
             k_slots = j0
-            bufs, sels = [], []
-            origins = [me]
-            for rnd in range(1, shards):
-                peer = (me + rnd) % shards
+
+            def dest_fn(blk, peer):
+                pt = (blk[9] != 0) & (blk[5] // n_local == peer)
                 lo = peer * n_local
-                dest_pt = pv_loc & (floc["recv"] // n_local == peer)
-                rmask = exchange.range_mask_words(lo, lo + n_local, w, 32)
-                dest_inv = floc["is_inv"] & jnp.any(
-                    (comb_loc & rmask[None, :]) != 0, axis=1
+                rmask = exchange.range_mask_words(
+                    lo, lo + n_local, w, 32
                 )
-                buf, sel, _ = exchange.compact(
-                    dest_pt | dest_inv, payload, k_slots
+                cw = jax.lax.bitcast_convert_type(
+                    jnp.stack(
+                        [blk[10 + wi] for wi in range(w)], axis=-1
+                    ),
+                    U32,
+                )  # [J, W]
+                inv = (blk[7] != 0) & jnp.any((cw & rmask) != 0, axis=-1)
+                return pt | inv
+
+            def fan_fn(blk, peer):
+                # receivers of an entry within shard ``peer``: INV
+                # fan-mask popcount over the peer's node range, 1 for
+                # point sends
+                lo = peer * n_local
+                rmask = exchange.range_mask_words(
+                    lo, lo + n_local, w, 32
                 )
-                bufs.append(
-                    jax.lax.ppermute(
-                        buf, axis_name, exchange.fwd_perm(shards, rnd)
-                    )
+                cw = jax.lax.bitcast_convert_type(
+                    jnp.stack(
+                        [blk[10 + wi] for wi in range(w)], axis=-1
+                    ),
+                    U32,
                 )
-                sels.append(sel)
-                origins.append(exchange.origin_of_round(me, shards, rnd))
+                pop = jnp.sum(
+                    jax.lax.population_count(cw & rmask), axis=-1
+                ).astype(I32)
+                return jnp.where(blk[7] != 0, pop, 1)
+
+            bufs, origins, xctx, xstats = exchange.forward(
+                xplan, axis_name, me, payload, dest_fn, k_slots,
+                fan_fn=fan_fn, ckey_row=10 + w, nkeys=n * m,
+            )
+            nb = len(bufs)
 
             def cat(i, local_row):
                 return jnp.concatenate(
@@ -1032,7 +1074,7 @@ def build_step(
             f["valid"] = pv_row | f["is_inv"]
             f["sharers"] = jnp.where(f["is_inv"][:, None], U32(0), comb)
             bounds = [0, j0] + [
-                j0 + (i + 1) * k_slots for i in range(shards - 1)
+                j0 + (i + 1) * k_slots for i in range(nb)
             ]
         j = f["valid"].shape[0]
 
@@ -1286,13 +1328,14 @@ def build_step(
         )                                                     # [W, J]
         fbrows = jnp.concatenate([acc_e[None, :], done_bits], axis=0)
         acc_tot = fbrows[:, :j0]
-        for i, sel in enumerate(sels):
-            fb = jax.lax.ppermute(
-                fbrows[:, bounds[i + 1] : bounds[i + 2]],
-                axis_name,
-                exchange.rev_perm(shards, i + 1),
+        if sharded and nb:
+            fb_blocks = [
+                fbrows[:, bounds[i + 1] : bounds[i + 2]]
+                for i in range(nb)
+            ]
+            acc_tot = acc_tot + exchange.feedback(
+                xplan, axis_name, fb_blocks, xctx
             )
-            acc_tot = acc_tot + exchange.uncompact(fb, sel)
         acc_j = acc_tot[0]                                    # [J0]
         # a point candidate has exactly one receiver, so "accepted" is
         # acc_j > 0; inv candidates read their accepted-receiver bits
@@ -1378,10 +1421,18 @@ def build_step(
             reo_inc = _event_cnt(k_reo, float(fault.reorder))
             del_inc = _event_cnt(k_del, float(fault.delay))
 
+        # cross-shard exchange telemetry (zero off the sharded path)
+        xsent_inc = xmc_inc = xcomb_inc = xhwm = zero
+        if sharded:
+            xsent_inc = xstats["sent"]
+            xmc_inc = xstats["mc_saved"]
+            xcomb_inc = xstats["combined"]
+            xhwm = xstats["hwm"]
         if axis_name is not None:
             # replicate every global counter (out_specs stay P()) with
             # ONE stacked psum — the collective-count guards pin the
-            # cycle loop to the exchange ppermutes plus this psum
+            # cycle loop to the exchange collectives plus this psum
+            # (and one pmax for the slot high-water mark)
             parts = [
                 jnp.stack(
                     [
@@ -1399,6 +1450,10 @@ def build_step(
                          del_inc]
                     )
                 )
+            if sharded:
+                parts.append(
+                    jnp.stack([xsent_inc, xmc_inc, xcomb_inc])
+                )
             vec = jax.lax.psum(jnp.concatenate(parts), axis_name)
             nt = len(MsgType)
             ov_now = vec[0] > 0
@@ -1411,6 +1466,12 @@ def build_step(
                 (retrans_inc, wstall_inc, dup_inc, reo_inc, del_inc) = [
                     vec[10 + nt + i] for i in range(5)
                 ]
+            if sharded:
+                base = 10 + nt + (5 if fault_on else 0)
+                xsent_inc, xmc_inc, xcomb_inc = [
+                    vec[base + i] for i in range(3)
+                ]
+                xhwm = jax.lax.pmax(xhwm, axis_name)
         overflow = st.overflow | ov_now
 
         # watchdog progress: an instruction retired or a mailbox
@@ -1508,6 +1569,10 @@ def build_step(
                 st.n_dir_overflow if over_inc is None
                 else st.n_dir_overflow + over_inc
             ),
+            n_exch_sent=st.n_exch_sent + xsent_inc,
+            n_exch_hwm=jnp.maximum(st.n_exch_hwm, xhwm),
+            n_exch_mc_saved=st.n_exch_mc_saved + xmc_inc,
+            n_exch_combined=st.n_exch_combined + xcomb_inc,
         )
 
     return step
@@ -1643,14 +1708,21 @@ def build_propose(config: SystemConfig, max_cycles: int = 1_000_000,
     plus two scalars: the watchdog boundary (idle time may not jump
     past ``last_progress + watchdog_cycles`` — simulated-cycle stall
     accounting survives elision) and the ``max_cycles`` boundary.
+
+    Shape-polymorphic over the node axis: under node sharding each
+    shard proposes from its local block and the runner folds the shard
+    axis into the same ``reduce_min`` (a ``pmin``).  The watchdog
+    candidate then keys on the *local* ``any(issuer)`` — a shard that
+    sees remote-only progress proposes a conservative (smaller) jump,
+    which costs extra device steps but never overshoots, so dumps and
+    cycle counts stay exact.
     """
-    n = config.num_procs
     w = config.sharer_words
     topo_on = config.interconnect.enabled
     mb_deliver = 5 + w  # deliver-at column (topology builds only)
 
     def propose(st: SimState) -> jnp.ndarray:
-        far = jnp.full((n,), _FAR, dtype=I32)
+        far = jnp.full_like(st.pc, _FAR)
         blocked = jnp.any(st.ob_valid, axis=1)
         has_mail = st.mb_count > 0
         if topo_on:
@@ -1682,9 +1754,16 @@ def build_propose(config: SystemConfig, max_cycles: int = 1_000_000,
     return propose
 
 
-def build_fast_forward(config: SystemConfig):
+def build_fast_forward(config: SystemConfig,
+                       axis_name: Optional[str] = None):
     """Build ``fast_forward(st, j) -> SimState``: advance j >= 1
     provably-silent cycles (j <= min(propose(st))) in one device step.
+
+    With ``axis_name`` the function is a node-sharded SPMD body: the
+    retired-instruction counters are replicated with one stacked
+    ``psum`` and the watchdog progress trail keys on the *global*
+    retire count (an issuer retires at least one hit whenever j >= 1,
+    so ``retired > 0`` is exactly ``any(issuer)`` across shards).
 
     Issuers retire exactly j silent hits each (j never exceeds any
     issuer's run length, so trace completion can only land on the jump
@@ -1740,12 +1819,20 @@ def build_fast_forward(config: SystemConfig):
         retired = jnp.sum(in_run.astype(I32))
         rd_inc = jnp.sum((in_run & (op == 0)).astype(I32))
         wr_inc = jnp.sum(is_w.astype(I32))
+        if axis_name is not None:
+            g = jax.lax.psum(
+                jnp.stack([retired, rd_inc, wr_inc]), axis_name
+            )
+            retired, rd_inc, wr_inc = g[0], g[1], g[2]
+            any_issuer = retired > 0
+        else:
+            any_issuer = jnp.any(issuer)
         pc = st.pc + jnp.where(issuer, j, 0)
         cycle = st.cycle + j
         # every elided cycle with issuers retires instructions, so the
         # watchdog sees the same progress trail as lockstep
         last_progress = jnp.where(
-            jnp.any(issuer), cycle - 1, st.last_progress
+            any_issuer, cycle - 1, st.last_progress
         )
         if fault_on:
             # lockstep splits the carried key once per cycle whether or

@@ -68,7 +68,10 @@ _NODE_LEADING = frozenset(
                  "n_elided", "n_multi_hit",
                  # protocol-variant scalar counters (dir_owner and
                  # snap_dir_owner ARE node-leading, so not listed)
-                 "n_forwards", "n_owner_xfer", "n_dir_overflow")
+                 "n_forwards", "n_owner_xfer", "n_dir_overflow",
+                 # cross-shard exchange telemetry (replicated scalars)
+                 "n_exch_sent", "n_exch_hwm", "n_exch_mc_saved",
+                 "n_exch_combined")
 )
 
 
@@ -141,9 +144,11 @@ def build_node_sharded_run(
     ``data``).
 
     The ``lax.while_loop`` lives *outside* the ``shard_map``: the loop
-    body is the manually-sharded SPMD step (the targeted ppermute
-    exchange of ``ops/exchange.py`` — ``2*(D-1)`` ppermutes plus one
-    stacked counter psum per cycle, no per-cycle all_gather), while the
+    body is the manually-sharded SPMD step (the targeted exchange of
+    ``ops/exchange.py`` on the ``config.exchange_mode`` collective
+    schedule — one batched ``all_to_all`` each way by default, see
+    ``exchange.plan_collectives`` — plus one stacked counter psum and
+    one telemetry pmax per cycle, no per-cycle all_gather), while the
     quiescence condition is computed on the global view so XLA inserts
     the cross-device reductions itself.
 
@@ -153,15 +158,17 @@ def build_node_sharded_run(
     host can raise a :class:`StallDiagnostic` instead of burning to
     ``max_cycles``.
 
-    Cycle elision (ISSUE-12) composes with DATA sharding: each shard
-    reduces its own lanes' proposals and one ``lax.pmin`` over the
-    ``data`` axis makes the jump the global batch minimum — exactly the
-    unsharded batched jump, so per-lane cycle counters stay
-    bit-identical to the single-device run.  With the NODE axis
-    actually sharded (node_shards > 1) elision is not implemented and
-    the loop silently stays lockstep — still bit-exact, just without
-    the device-step savings (a per-node-shard propose would also need
-    its events folded across the exchange rounds; deferred).
+    Cycle elision (ISSUE-12) composes with BOTH mesh axes (ISSUE-15):
+    each shard reduces its own lanes'/nodes' proposals and one
+    ``lax.pmin`` (over ``data``, plus ``node`` when the node axis is
+    actually sharded) makes the jump the global minimum — exactly the
+    unsharded jump, so dumps and per-lane cycle counters stay
+    bit-identical to the single-device run.  Under node sharding the
+    watchdog candidate in ``propose`` keys on each shard's *local*
+    issuers, which can only shrink the jump (extra device steps, never
+    an overshoot), so only ``n_elided`` may differ from the unsharded
+    elided run — cycle counts, dumps, and every architectural stat
+    stay exact.
     """
     node_shards = mesh.shape["node"]
     step = build_step(
@@ -171,16 +178,22 @@ def build_node_sharded_run(
     body = step
     if batched:
         body = jax.vmap(step)
-    if config.elide and node_shards == 1:
+    if config.elide:
         propose = build_propose(config, max_cycles, watchdog_cycles)
-        ff = build_fast_forward(config)
+        ff = build_fast_forward(
+            config, axis_name="node" if node_shards > 1 else None
+        )
         lockstep = body
+        # the jump must be the global minimum so every shard takes the
+        # same branch (the predicate is replicated — required for the
+        # collectives inside the cond branches)
+        axes = "data" if node_shards == 1 else ("data", "node")
         if batched:
             vff = jax.vmap(ff, in_axes=(0, None))
             vprop = jax.vmap(propose)
 
             def body(st):
-                j = jax.lax.pmin(jnp.min(vprop(st)), "data")
+                j = jax.lax.pmin(jnp.min(vprop(st)), axes)
                 return jax.lax.cond(
                     j > 0, lambda s: vff(s, j), lockstep, st
                 )
@@ -188,7 +201,7 @@ def build_node_sharded_run(
         else:
 
             def body(st):
-                j = jax.lax.pmin(jnp.min(propose(st)), "data")
+                j = jax.lax.pmin(jnp.min(propose(st)), axes)
                 return jax.lax.cond(
                     j > 0, lambda s: ff(s, j), lockstep, st
                 )
@@ -256,6 +269,7 @@ class NodeShardedEngine:
         traces: Sequence[Sequence[Instr]],
         mesh: Optional[Mesh] = None,
         max_cycles: int = 1_000_000,
+        watchdog_cycles: int = 10_000,
     ):
         if mesh is None:
             mesh = make_mesh(node_shards=len(jax.devices()))
@@ -271,10 +285,13 @@ class NodeShardedEngine:
             )
         self.config = config
         self.mesh = mesh
+        self.max_cycles = max_cycles
+        self.watchdog_cycles = watchdog_cycles
         self._specs = state_specs(batched=False)
         self.state = _place(init_state(config, traces), mesh, self._specs)
         self._run = build_node_sharded_run(
-            config, mesh, batched=False, max_cycles=max_cycles
+            config, mesh, batched=False, max_cycles=max_cycles,
+            watchdog_cycles=watchdog_cycles,
         )
 
     def run(self) -> "NodeShardedEngine":
@@ -284,8 +301,25 @@ class NodeShardedEngine:
         if bool(st.overflow):
             raise StallError("internal invariant violated: mailbox overflow despite backpressure")
         if not bool(quiescent(st)):
+            cycle = int(st.cycle)
+            stalled_for = cycle - int(st.last_progress)
+            if (
+                self.watchdog_cycles
+                and cycle < self.max_cycles
+                and stalled_for >= self.watchdog_cycles
+            ):
+                # same diagnostic (and trip cycle) as the single-chip
+                # engine: the watchdog counts simulated cycles, which
+                # sharding and elision both preserve exactly
+                from hpa2_tpu.ops.engine import stall_diagnostic
+
+                raise stall_diagnostic(
+                    self.config, st,
+                    "watchdog: no instruction retired and no mailbox "
+                    f"drained for {stalled_for} cycles",
+                )
             raise StallError(
-                f"no quiescence after {int(st.cycle)} cycles (livelock?)"
+                f"no quiescence after {cycle} cycles (livelock?)"
             )
         return self
 
@@ -314,7 +348,17 @@ class NodeShardedEngine:
         return int(self.state.n_msgs)
 
     def stats(self) -> dict:
-        return engine_stats(self.state)
+        out = engine_stats(self.state)
+        sent = int(np.asarray(self.state.n_exch_sent))
+        if sent:
+            # ICI traffic model: every shipped exchange entry is one
+            # [10 + sharer_words + 1]-row i32 column (ops/step.py
+            # payload + combining key)
+            rows = 10 + self.config.sharer_words + 1
+            out["exchange_bytes_per_cycle"] = round(
+                sent * rows * 4 / max(self.cycle, 1), 2
+            )
+        return out
 
 
 class GridEngine:
@@ -741,17 +785,80 @@ class DataShardedLaneSession(PallasLaneSession):
 # ``node`` axis, composing with ``data`` lane sharding on the same 2-D
 # mesh.  Collectives cannot run inside a Mosaic kernel, so this path
 # runs ``build_cycle`` at the XLA level under ``shard_map``: phase C is
-# the targeted exchange of ``ops/exchange.py`` — exactly ``2*(D-1)``
-# ppermutes plus ONE stacked counter psum per cycle, no per-cycle
-# all_gather (tests/test_node_sharded_pallas.py pins the counts) — and
+# the targeted exchange of ``ops/exchange.py`` on the
+# ``config.exchange_mode`` collective schedule (one batched
+# ``all_to_all`` each way by default; see
+# ``exchange.plan_collectives``) plus ONE stacked counter psum and ONE
+# telemetry pmax per cycle, no per-cycle all_gather
+# (tests/test_node_sharded_pallas.py pins the counts) — and
 # quiescence rides the psum'd ``activeg`` row for free.
 # ---------------------------------------------------------------------------
 
 # transient [1, lanes] rows threaded through the node-sharded cycle in
 # the state dict (never part of pallas_engine.state_shapes): psum'd
 # global activity (the quiescence gate), cumulative cross-shard
-# messages, sticky exchange-overflow flag
-_PALLAS_TRANSIENTS = ("activeg", "xmsgs", "exchov")
+# messages, sticky exchange-overflow flag, and the ISSUE-15 exchange
+# telemetry — slot high-water mark (running max), multicast/combining
+# savings (accumulators), and the packed worst-overflow diagnostic
+# words (demand<<16|src<<8|dst and demand<<16|cycle, running max)
+_PALLAS_TRANSIENTS = (
+    "activeg", "xmsgs", "exchov",
+    "exchhw", "exchmc", "exchcb", "exchdg", "exchdc",
+)
+
+
+def _pallas_exchange_stats(config: SystemConfig, state: dict) -> dict:
+    """The ISSUE-15 exchange-telemetry block from the transient rows,
+    same only-when-nonzero keys as ``engine_stats`` on the jax path.
+    Every shipped entry is one [W + SW + 3]-row i32 column (candidate
+    words + INV fan-mask words + recv/isa/ckey)."""
+    from hpa2_tpu.ops.pallas_engine import (
+        _SC_CYCLE, _mb_layout, _sharer_words,
+    )
+
+    out = {}
+    sent = int(np.sum(np.asarray(state["xmsgs"])))
+    if sent:
+        out["exchange_sent"] = sent
+        rows = _mb_layout(config)[1] + _sharer_words(config) + 3
+        cyc = int(np.max(np.asarray(state["scalars"])[_SC_CYCLE]))
+        out["exchange_bytes_per_cycle"] = round(
+            sent * rows * 4 / max(cyc, 1), 2
+        )
+    hwm = int(np.max(np.asarray(state["exchhw"])))
+    if hwm:
+        out["exchange_slot_hwm"] = hwm
+    mc = int(np.sum(np.asarray(state["exchmc"])))
+    if mc:
+        out["exchange_multicast_saved"] = mc
+    cb = int(np.sum(np.asarray(state["exchcb"])))
+    if cb:
+        out["exchange_combined"] = cb
+    return out
+
+
+def _exchange_overflow_error(state: dict, exchange_slots) -> StallError:
+    """Decode the pmax'd worst-overflow diagnostic words into a LOUD,
+    actionable message naming the cycle, the shard pair, and demand vs
+    capacity (both words lead with the demand in the top 16 bits, so
+    the two maxima describe the same event)."""
+    dg = int(np.max(np.asarray(state["exchdg"])))
+    dc = int(np.max(np.asarray(state["exchdc"])))
+    detail = ""
+    if dg > 0:
+        demand = dg >> 16
+        more = "+" if demand >= 0xFFFF else ""
+        detail = (
+            f" — worst cycle {dc & 0xFFFF}: shard "
+            f"{(dg >> 8) & 0xFF} -> {dg & 0xFF} demanded "
+            f"{demand}{more} slots"
+        )
+    return StallError(
+        "cross-shard exchange overflow: a cycle had more out-bound "
+        "candidates for one peer shard than "
+        f"exchange_slots={exchange_slots}; raise it (the "
+        f"capacity-exact default never overflows){detail}"
+    )
 
 
 def _node_plane_spec(key: str, ndim: int) -> P:
@@ -1237,13 +1344,15 @@ class NodeShardedPallasEngine(PallasEngine):
     def _check_status(self, status: int, max_cycles: int) -> None:
         if status & 4:
             self._poisoned = True
-            raise StallError(
-                "cross-shard exchange overflow: a cycle had more "
-                "out-bound candidates for one peer shard than "
-                f"exchange_slots={self._exchange_slots}; raise it (the "
-                "capacity-exact default never overflows)"
+            raise _exchange_overflow_error(
+                self.state, self._exchange_slots
             )
         super()._check_status(status, max_cycles)
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update(_pallas_exchange_stats(self.config, self.state))
+        return out
 
 
 class NodeShardedLaneSession(PallasLaneSession):
@@ -1388,15 +1497,17 @@ class NodeShardedLaneSession(PallasLaneSession):
 
     def check(self, status) -> None:
         if int(status) & 4:
-            raise StallError(
-                "cross-shard exchange overflow: a cycle had more "
-                "out-bound candidates for one peer shard than "
-                f"exchange_slots={self._exchange_slots}; raise it (the "
-                "capacity-exact default never overflows)"
+            raise _exchange_overflow_error(
+                self.state, self._exchange_slots
             )
         super().check(status)
 
     def counters_of(self, cols) -> dict:
         out = super().counters_of(cols)
         out["cross_shard_msgs"] = int(np.sum(np.asarray(cols["xmsgs"])))
+        out["exchange_slot_hwm"] = int(np.max(np.asarray(cols["exchhw"])))
+        out["exchange_multicast_saved"] = int(
+            np.sum(np.asarray(cols["exchmc"]))
+        )
+        out["exchange_combined"] = int(np.sum(np.asarray(cols["exchcb"])))
         return out

@@ -37,6 +37,12 @@ from hpa2_tpu.ops.state import SimState
 _MAGIC = "hpa2_checkpoint_v1"
 _SPEC_MAGIC = "hpa2_spec_checkpoint_v1"
 
+# Replicated telemetry counters that may be absent from checkpoints
+# written before they existed; zero-backfilled on load.
+_ZERO_BACKFILL = frozenset({
+    "n_exch_sent", "n_exch_hwm", "n_exch_mc_saved", "n_exch_combined",
+})
+
 
 def _config_to_json(config: SystemConfig) -> str:
     d = dataclasses.asdict(config)
@@ -91,6 +97,12 @@ def load_state(path: str, with_meta: bool = False):
         for name in SimState._fields:
             key = f"f_{name}"
             if key not in z:
+                if name in _ZERO_BACKFILL:
+                    # telemetry counters added after the checkpoint was
+                    # written — resume with zeros (batch shape follows
+                    # an always-present scalar counter)
+                    leaves.append(jnp.zeros_like(jnp.asarray(z["f_n_msgs"])))
+                    continue
                 raise ValueError(
                     f"{path}: missing field {name} (incompatible "
                     "checkpoint version)"

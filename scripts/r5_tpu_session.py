@@ -44,8 +44,20 @@ Steps, in value order:
                      dumps bit-exactness gate + measured cross-shard
                      ICI traffic), then the node_shards ladder
                      (scripts/scale_runs.py nodeshard →
-                     MULTICHIP_r07.json) and a sharded-only 4096-node
-                     geometry no single chip fits
+                     MULTICHIP_r07.json), the ISSUE-15 old-vs-new
+                     exchange A/B ladder (scripts/scale_runs.py
+                     nodeshard_ab → MULTICHIP_r08.json) and a
+                     sharded-only 4096-node geometry no single chip
+                     fits
+  nodeshard_x2x4     ISSUE-15 batched-exchange rungs on the reference
+                     geometry: 64 nodes at 2 and 4 shards under the
+                     a2a schedule plus a butterfly x4 rung, each
+                     bit-exactness-gated with the per-cycle collective
+                     budget recorded
+  elided_nodeshard   ISSUE-15 cycle elision across the shard mesh
+                     (NodeShardedEngine, hot-set zipf): elide on/off
+                     wall-clock + elided-cycle counters, dumps/cycle
+                     bit-identity gate at node_shards=4
  15. serve512      — ISSUE-10 always-on serving at 32768 resident
                      lanes (bench.py --serve with
                      HPA2_SERVE_RESIDENT=32768): sustained ops/sec +
@@ -392,25 +404,34 @@ def measure_elision_child(params) -> int:
 
 def measure_nodeshard_child(params) -> int:
     """--measure-nodeshard mode: one system's node planes split over
-    ``shards`` devices (NodeShardedPallasEngine, targeted ppermute
+    ``shards`` devices (NodeShardedPallasEngine, batched collective
     exchange), timed, with the measured cross-shard traffic.  With
     ``compare=1`` the same workload also runs on the single-chip
     kernel and the whole state must be bit-exact (nonzero exit
     otherwise); ``compare=0`` is for geometries one chip cannot hold.
-    Params: procs batch instrs block k cap window gate shards compare.
+    Params: procs batch instrs block k cap window gate shards compare
+    [mode_idx] — mode_idx indexes EXCHANGE_MODES (-1 keeps the config
+    default, a2a).
     """
+    import dataclasses
+
     import numpy as np
 
     from hpa2_tpu.config import Semantics, SystemConfig
+    from hpa2_tpu.ops import exchange as xops
     from hpa2_tpu.ops.pallas_engine import PallasEngine
     from hpa2_tpu.parallel.sharding import NodeShardedPallasEngine
     from hpa2_tpu.utils.trace import gen_uniform_random_arrays
 
     (procs, batch, instrs, block, k, cap, window, gate, shards,
      compare) = params[:10]
+    mode_idx = params[10] if len(params) > 10 else -1
     config = SystemConfig(num_procs=procs, msg_buffer_size=cap,
                           max_instr_num=0,
                           semantics=Semantics().robust())
+    if mode_idx >= 0:
+        config = dataclasses.replace(
+            config, exchange_mode=xops.EXCHANGE_MODES[mode_idx])
     arrays = gen_uniform_random_arrays(config, batch, instrs, seed=0)
     kw = dict(block=block, cycles_per_call=k, snapshots=False,
               trace_window=window, gate=bool(gate))
@@ -428,6 +449,7 @@ def measure_nodeshard_child(params) -> int:
     timed(mk_sharded)  # compile + warm
     shd, shd_dt = timed(mk_sharded)
     xmsgs = shd.cross_shard_msgs
+    stats = shd.stats()
     rec = {
         "procs": procs, "batch": batch, "instrs": instrs,
         "block": block, "k": k, "cap": cap, "window": window,
@@ -438,7 +460,16 @@ def measure_nodeshard_child(params) -> int:
         "cross_shard_msgs": xmsgs,
         "cross_shard_msgs_per_cycle": round(
             xmsgs / max(shd.cycle, 1), 2),
-        "ppermutes_per_cycle": 2 * (shards - 1),
+        "exchange_mode": config.exchange_mode,
+        "collectives_per_cycle": xops.plan_collectives(
+            xops.make_plan(shards, config.exchange_mode,
+                           config.exchange_inner)),
+        "exchange_slot_hwm": stats.get("exchange_slot_hwm", 0),
+        "exchange_bytes_per_cycle": stats.get(
+            "exchange_bytes_per_cycle", 0),
+        "exchange_multicast_saved": stats.get(
+            "exchange_multicast_saved", 0),
+        "exchange_combined": stats.get("exchange_combined", 0),
     }
     exact = True
     if compare:
@@ -459,6 +490,57 @@ def measure_nodeshard_child(params) -> int:
         )
     print(json.dumps(rec))
     return 0 if exact else 1
+
+
+def measure_nodeshard_elision_child(params) -> int:
+    """--measure-nodeshard-elision mode: cycle elision across the
+    node-shard mesh (the round-15 psum-min jump).  One system's node
+    planes split over ``shards`` devices on the jax path
+    (NodeShardedEngine), hot-set zipf workload, elide on vs off —
+    dumps and cycle count must agree (nonzero exit otherwise) and the
+    on-run must actually skip cycles.  Params: procs instrs shards.
+    """
+    import dataclasses
+
+    import numpy as np
+
+    from hpa2_tpu.config import Semantics, SystemConfig
+    from hpa2_tpu.parallel.sharding import NodeShardedEngine, make_mesh
+    from hpa2_tpu.utils.trace import gen_hot_hit_zipf
+
+    procs, instrs, shards = params[:3]
+    config = SystemConfig(num_procs=procs,
+                          semantics=Semantics().robust())
+    traces = gen_hot_hit_zipf(config, instrs, seed=0)
+    mesh = make_mesh(node_shards=shards)
+
+    def timed(cfg):
+        NodeShardedEngine(cfg, traces, mesh=mesh).run()  # warm
+        eng = NodeShardedEngine(cfg, traces, mesh=mesh)
+        t0 = time.perf_counter()
+        eng.run()
+        return eng, time.perf_counter() - t0
+
+    on, on_dt = timed(config)
+    off, off_dt = timed(dataclasses.replace(config, elide=False))
+    exact = all(
+        bool(np.array_equal(np.asarray(getattr(on.state, f)),
+                            np.asarray(getattr(off.state, f))))
+        for f in on.state._fields
+        if f not in ("n_elided", "n_multi_hit"))
+    cycles = int(on.state.cycle)
+    elided = int(np.sum(np.asarray(on.state.n_elided)))
+    print(json.dumps({
+        "procs": procs, "instrs": instrs, "node_shards": shards,
+        "elide_s": round(on_dt, 3), "no_elide_s": round(off_dt, 3),
+        "wall_speedup": round(off_dt / on_dt, 2) if on_dt else None,
+        "simulated_cycles": cycles, "elided_cycles": elided,
+        "step_reduction":
+            round(cycles / (cycles - elided), 2) if cycles > elided
+            else None,
+        "bit_exact": exact,
+    }))
+    return 0 if exact and elided > 0 else 1
 
 
 def measure(step, batch, instrs, block, k, cap, window, gate,
@@ -554,7 +636,11 @@ def main() -> int:
         )
     if sys.argv[1:2] == ["--measure-nodeshard"]:
         return measure_nodeshard_child(
-            [int(x) for x in sys.argv[2:12]]
+            [int(x) for x in sys.argv[2:13]]
+        )
+    if sys.argv[1:2] == ["--measure-nodeshard-elision"]:
+        return measure_nodeshard_elision_child(
+            [int(x) for x in sys.argv[2:5]]
         )
     session_start = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     skip = set()
@@ -777,6 +863,13 @@ def main() -> int:
             [os.path.join(REPO, "scripts", "scale_runs.py"),
              "nodeshard"],
             timeout_s=1800, argv=True))
+        # the old-vs-new exchange A/B ladder (ISSUE-15) — on real ICI
+        # this rewrites MULTICHIP_r08.json with indicative:true numbers
+        note(run_py(
+            "nodeshard_ab",
+            [os.path.join(REPO, "scripts", "scale_runs.py"),
+             "nodeshard_ab"],
+            timeout_s=2400, argv=True))
         # the geometry the node axis exists for: 4096 simulated nodes,
         # more than one chip holds — sharded-only, no single-chip
         # reference (compare=0)
@@ -786,6 +879,35 @@ def main() -> int:
              "4096", "8", "32", "8", "64", "16", "16", "0",
              "4", "0"],
             timeout_s=2400, argv=True))
+
+    if "nodeshard_x2x4" not in skip and gate("nodeshard_x2x4"):
+        # ISSUE-15: the PR-7 reference geometry again at 2 and 4 node
+        # shards under the batched a2a schedule (mode_idx 1) plus a
+        # butterfly x4 rung (mode_idx 2) — bit-exactness gates each
+        # step, and the recorded collectives_per_cycle is the ICI
+        # dispatch budget the new transport pays per simulated cycle
+        for label, shards, mode_idx in (
+            ("nodeshard_x2", "2", "1"),
+            ("nodeshard_x4", "4", "1"),
+            ("nodeshard_x4_butterfly", "4", "2"),
+        ):
+            note(run_py(
+                label,
+                [os.path.abspath(__file__), "--measure-nodeshard",
+                 "64", "1024", "64", "512", "64", "16", "16", "0",
+                 shards, "1", mode_idx],
+                timeout_s=1800, argv=True))
+
+    if "elided_nodeshard" not in skip and gate("elided_nodeshard"):
+        # ISSUE-15: cycle elision across the shard mesh — the psum-min
+        # jump must pay on a hot-set workload while staying bit-exact
+        # with the lockstep sharded run (the child exits nonzero when
+        # either fails)
+        note(run_py(
+            "elided_nodeshard",
+            [os.path.abspath(__file__),
+             "--measure-nodeshard-elision", "64", "256", "4"],
+            timeout_s=1800, argv=True))
     return 0
 
 

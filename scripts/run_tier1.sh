@@ -52,12 +52,15 @@ then
   echo "TIER1: packed+fused smoke failed" >&2
   exit 1
 fi
-# Node-shard smoke (~30s, virtual 2x2 mesh): the ISSUE-7 fast path —
-# one system's node planes split over the mesh's node axis with the
-# targeted ppermute exchange, composed with data sharding — must stay
-# bit-exact against the single-chip jax engine's dumps and actually
-# ship cross-shard traffic.  Catches exchange wiring breaks cheaply.
-if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python - > /dev/null <<'EOF'
+# Node-shard smoke (~45s, virtual mesh): the ISSUE-7/ISSUE-15 fast
+# path — one system's node planes split over the mesh's node axis with
+# the targeted batched exchange, composed with data sharding on the
+# 2x2 mesh AND at node_shards=4 under a non-default collective
+# schedule — must stay bit-exact against the single-chip jax engine's
+# dumps, ship cross-shard traffic, and report the exchange telemetry.
+# Catches exchange/transport wiring breaks cheaply.
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python - > /dev/null <<'EOF'
+import dataclasses
 from hpa2_tpu.config import Semantics, SystemConfig
 from hpa2_tpu.ops.engine import JaxEngine
 from hpa2_tpu.parallel.sharding import NodeShardedPallasEngine
@@ -69,10 +72,22 @@ eng = NodeShardedPallasEngine(
     cfg, *traces_to_arrays(cfg, batch), node_shards=2, data_shards=2,
     snapshots=False, cycles_per_call=16).run()
 assert eng.cross_shard_msgs > 0
-for s, traces in enumerate(batch):
-    ref = JaxEngine(cfg, traces).run()
+refs = [JaxEngine(cfg, traces).run() for traces in batch]
+for s, ref in enumerate(refs):
     assert [d.__dict__ for d in eng.system_final_dumps(s)] == [
         d.__dict__ for d in ref.final_dumps()], f"system {s} diverged"
+# 4-device rung on the round-15 transport: butterfly schedule,
+# telemetry keys live
+eng4 = NodeShardedPallasEngine(
+    dataclasses.replace(cfg, exchange_mode="butterfly"),
+    *traces_to_arrays(cfg, batch), node_shards=4,
+    snapshots=False, cycles_per_call=16).run()
+for s, ref in enumerate(refs):
+    assert [d.__dict__ for d in eng4.system_final_dumps(s)] == [
+        d.__dict__ for d in ref.final_dumps()], f"x4 system {s} diverged"
+stats = eng4.stats()
+assert stats["exchange_sent"] > 0, stats
+assert stats["exchange_slot_hwm"] >= 1, stats
 EOF
 then
   echo "TIER1: node-shard smoke failed" >&2

@@ -237,6 +237,84 @@ def test_bit_exact_data_sharded():
         assert on.system_final_dumps(b) == ref.system_final_dumps(b)
 
 
+def test_bit_exact_node_sharded():
+    """Config.elide at node_shards > 1 (ISSUE-15: the jump proposal
+    folded with a psum-min over BOTH mesh axes): byte-identical to the
+    lockstep sharded run AND the single-chip elided run, with real
+    elision on the hot-hit workload."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 local devices")
+    from hpa2_tpu.parallel import NodeShardedEngine, make_mesh
+
+    cfg = _cfg()
+    traces = gen_hot_hit_zipf(cfg, 64, seed=1)
+    on = NodeShardedEngine(
+        cfg, traces, mesh=make_mesh(node_shards=2)
+    ).run()
+    off = NodeShardedEngine(
+        dataclasses.replace(cfg, elide=False), traces,
+        mesh=make_mesh(node_shards=2),
+    ).run()
+    assert on.cycle == off.cycle
+    assert on.final_dumps() == off.final_dumps()
+    assert on.snapshots() == off.snapshots()
+    assert _strip(on.stats()) == _strip(off.stats())
+    assert on.stats()["elided_cycles"] > 0
+    # the single-chip elided engine agrees on every architectural fact
+    ref = JaxEngine(cfg, traces).run()
+    assert on.cycle == int(ref.state.cycle)
+    assert on.final_dumps() == ref.final_dumps()
+    assert on.snapshots() == ref.snapshots()
+
+
+def test_bit_exact_grid_2x2_mesh():
+    """Elision on the full 2-D (data, node) mesh: batched proposals
+    reduce locally, one pmin over both axes makes the global jump."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 local devices")
+    from hpa2_tpu.parallel import GridEngine, make_mesh
+
+    cfg = _cfg()
+    batch = _zipf_batch(cfg, 2, 48)
+    mesh = make_mesh(node_shards=2, data_shards=2)
+    on = GridEngine(cfg, batch, mesh=mesh).run()
+    off = GridEngine(
+        dataclasses.replace(cfg, elide=False), batch, mesh=mesh
+    ).run()
+    ref = BatchJaxEngine(cfg, batch).run()
+    for b in range(len(batch)):
+        assert on.system_snapshots(b) == off.system_snapshots(b)
+        assert on.system_snapshots(b) == ref.system_snapshots(b)
+    assert int(np.sum(np.asarray(on.state.n_elided))) > 0
+    assert int(np.sum(np.asarray(off.state.n_elided))) == 0
+
+
+def test_watchdog_agreement_node_sharded():
+    """The sharded elided run trips the watchdog at the same simulated
+    cycle as the single-chip run — the shard-local issuer key in the
+    propose can only shrink jumps, never overshoot the trip point."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 local devices")
+    from hpa2_tpu.parallel import NodeShardedEngine, make_mesh
+
+    cfg = _cfg(
+        fault=FaultModel(drop=1.0, edge_sender=1, edge_receiver=0,
+                         seed=1)
+    )
+    traces = gen_uniform_random(cfg, 16, seed=3)
+    ref = JaxEngine(cfg, traces, watchdog_cycles=50)
+    with pytest.raises(StallDiagnostic) as ref_ei:
+        ref.run()
+    shd = NodeShardedEngine(
+        cfg, traces, mesh=make_mesh(node_shards=2),
+        watchdog_cycles=50,
+    )
+    with pytest.raises(StallDiagnostic) as shd_ei:
+        shd.run()
+    assert "watchdog" in str(shd_ei.value)
+    assert shd_ei.value.cycle == ref_ei.value.cycle
+
+
 def test_pallas_lockstep_unaffected_packed_planes():
     """The Pallas family (packed planes included) accepts the elide
     knob but runs lockstep: zero elision counters, results identical

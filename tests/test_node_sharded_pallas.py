@@ -4,15 +4,18 @@ with phase-C delivery running as the targeted cross-shard exchange
 (ops/exchange.py) at the XLA level.
 
 Everything here must be *bit-identical* to the single-chip engines —
-same state planes, same counters, same per-node dumps — and the cycle
-loop must contain only the exchange collectives: ``2*(D-1)`` ppermutes
-plus ONE stacked counter psum per cycle, no per-cycle ``all_gather``.
+same state planes, same counters, same per-node dumps — for EVERY
+``exchange_mode``, and the cycle loop must contain only the plan's
+collectives (``exchange.plan_collectives``: one batched ``all_to_all``
+each way by default) plus ONE stacked counter psum and ONE telemetry
+pmax per cycle, no per-cycle ``all_gather``.
 
 Runs on the virtual 8-device CPU mesh from conftest.  The interpret-
 mode single-chip references dominate the wall clock, so they are
 shared across tests via module-level caches.
 """
 
+import dataclasses
 import functools
 
 import jax
@@ -21,6 +24,7 @@ import pytest
 
 from hpa2_tpu.config import Semantics, SystemConfig
 from hpa2_tpu.models.spec_engine import StallError
+from hpa2_tpu.ops import exchange
 from hpa2_tpu.ops.engine import JaxEngine
 from hpa2_tpu.ops.pallas_engine import PallasEngine
 from hpa2_tpu.ops.schedule import Schedule
@@ -72,7 +76,13 @@ def _assert_bit_exact(shd, ref):
     assert shd.cycle == ref.cycle
     assert shd.instructions == ref.instructions
     assert shd.messages == ref.messages
-    assert shd.stats() == ref.stats()
+    # the sharded run reports the exchange telemetry block on top of
+    # the (byte-identical) architectural counters
+    shd_stats = {
+        k: v for k, v in shd.stats().items()
+        if not k.startswith("exchange_")
+    }
+    assert shd_stats == ref.stats()
     for s in range(ref.b):
         assert [d.__dict__ for d in shd.system_final_dumps(s)] == [
             d.__dict__ for d in ref.system_final_dumps(s)
@@ -101,6 +111,25 @@ def test_bit_exact_vs_single_device(node_shards, data_shards):
     assert shd.cross_shard_msgs > 0, (
         "uniform-random traffic must cross shards"
     )
+    stats = shd.stats()
+    assert stats["exchange_sent"] == shd.cross_shard_msgs
+    assert stats["exchange_slot_hwm"] >= 1
+    assert stats["exchange_bytes_per_cycle"] > 0
+
+
+@pytest.mark.parametrize("mode", ["pairwise", "butterfly", "hier"])
+def test_bit_exact_every_exchange_mode(mode):
+    """``a2a`` (the default) is exercised by every test above; the
+    alternative collective schedules must keep every plane and dump
+    byte-identical too — the transport plan only changes HOW entries
+    travel, never what arrives."""
+    _require_devices(4)
+    ref = _ref()
+    cfg = dataclasses.replace(_cfg(), exchange_mode=mode)
+    shd = NodeShardedPallasEngine(
+        cfg, *_arrays(), node_shards=4, cycles_per_call=16,
+    ).run()
+    _assert_bit_exact(shd, ref)
 
 
 def test_bit_exact_4x2_mesh_snapshots_off():
@@ -186,14 +215,19 @@ def test_fused_schedule_bit_exact(packed):
 
 def test_exchange_slots_overflow_is_loud():
     """A too-small per-peer exchange buffer must fail the whole run
-    with a StallError, never drop messages silently."""
+    with a StallError, never drop messages silently — and the message
+    must name the worst event: cycle, shard pair, demand vs capacity."""
     _require_devices(2)
     eng = NodeShardedPallasEngine(
         _cfg(), *_arrays(), node_shards=2, exchange_slots=1,
         cycles_per_call=16,
     )
-    with pytest.raises(StallError, match="exchange overflow"):
+    with pytest.raises(StallError, match="exchange overflow") as ei:
         eng.run()
+    msg = str(ei.value)
+    assert "exchange_slots=1" in msg
+    assert "worst cycle" in msg, f"overflow diagnostics missing: {msg}"
+    assert "demanded" in msg
 
 
 # -- geometry validation ----------------------------------------------
@@ -221,11 +255,13 @@ def test_geometry_validation():
 # -- collective-count guards (jaxpr layer) ----------------------------
 #
 # The whole point of the targeted exchange: the cycle loop carries
-# exactly 2*(D-1) ppermutes (forward buffers + acceptance feedback)
-# plus ONE stacked counter psum, and never an all_gather.  Counting
-# primitives in the traced program pins this — a regression to
-# gather-the-world delivery shows up as all_gather > 0 or a changed
-# ppermute count.
+# exactly the transport plan's collectives (one batched all_to_all
+# each way for the default "a2a" mode; 2*(D-1) ppermutes for
+# "pairwise"; 2*log2(D) for "butterfly"; 2*(Di+Do-2) for "hier") plus
+# ONE stacked counter psum and ONE telemetry pmax, and never an
+# all_gather.  Counting primitives in the traced program pins this —
+# a regression to gather-the-world delivery or a serial-round relapse
+# shows up as all_gather > 0 or a changed collective count.
 
 
 def _subvalues(eqn):
@@ -259,14 +295,31 @@ def _count_prims(jaxpr, names):
 
 
 _PSUM_PRIMS = ("psum", "psum2", "psum_invariant")
-_GATHER_PRIMS = ("all_gather", "all_to_all", "all_gather_invariant")
+# NOTE: all_to_all is a *legitimate* exchange collective since the
+# batched-transport rework — only the gather-the-world family is banned
+_GATHER_PRIMS = ("all_gather", "all_gather_invariant")
+_MODES = ("pairwise", "a2a", "butterfly", "hier")
 
 
+def _collective_counts(bodies):
+    return {
+        "ppermute": sum(_count_prims(b, ("ppermute",)) for b in bodies),
+        "all_to_all": sum(
+            _count_prims(b, ("all_to_all",)) for b in bodies
+        ),
+        "psum": sum(_count_prims(b, _PSUM_PRIMS) for b in bodies),
+        "pmax": sum(_count_prims(b, ("pmax",)) for b in bodies),
+        "gather": sum(_count_prims(b, _GATHER_PRIMS) for b in bodies),
+    }
+
+
+@pytest.mark.parametrize("mode", _MODES)
 @pytest.mark.parametrize("node_shards", [2, 4])
-def test_cycle_loop_collectives_pinned(node_shards):
+def test_cycle_loop_collectives_pinned(node_shards, mode):
     _require_devices(node_shards)
+    cfg = dataclasses.replace(_cfg(), exchange_mode=mode)
     eng = NodeShardedPallasEngine(
-        _cfg(), *_arrays(), node_shards=node_shards,
+        cfg, *_arrays(), node_shards=node_shards,
         cycles_per_call=16,
     )
     jx = jax.make_jaxpr(eng._runner(10_000))(
@@ -274,31 +327,120 @@ def test_cycle_loop_collectives_pinned(node_shards):
     ).jaxpr
     bodies = _find_subjaxprs(jx, "shard_map")
     assert bodies, "node-sharded runner lost its shard_map"
-    n_permute = sum(_count_prims(b, ("ppermute",)) for b in bodies)
-    n_psum = sum(_count_prims(b, _PSUM_PRIMS) for b in bodies)
-    n_pmax = sum(_count_prims(b, ("pmax",)) for b in bodies)
-    n_gather = sum(_count_prims(b, _GATHER_PRIMS) for b in bodies)
-    assert n_permute == 2 * (node_shards - 1), (
-        f"cycle must ship {2 * (node_shards - 1)} ppermutes "
-        f"(fwd + feedback per peer round), found {n_permute}"
+    got = _collective_counts(bodies)
+    plan = exchange.plan_collectives(
+        exchange.make_plan(node_shards, mode, 0)
+    )
+    assert got["ppermute"] == plan["ppermute"], (
+        f"{mode}@{node_shards}: plan ships {plan['ppermute']} "
+        f"ppermutes, traced {got['ppermute']}"
+    )
+    assert got["all_to_all"] == plan["all_to_all"], (
+        f"{mode}@{node_shards}: plan ships {plan['all_to_all']} "
+        f"all_to_alls, traced {got['all_to_all']}"
     )
     # one stacked counter/quiescence psum in the cycle + the per-
     # segment activity seed psum outside the cycle loop
-    assert n_psum == 2, f"expected cycle psum + seed psum, got {n_psum}"
-    # the whole-mesh loop gate: one pmax per k-cycle call, outside the
-    # cycle loop (traced twice: the while seed and the loop body)
-    assert n_pmax == 2, f"expected seed + per-call loop-gate pmax, got {n_pmax}"
-    assert n_gather == 0, (
-        f"{n_gather} gather-the-world collective(s) crept back into "
-        "the node-sharded run program"
+    assert got["psum"] == 2, (
+        f"expected cycle psum + seed psum, got {got['psum']}"
+    )
+    # in-cycle telemetry pmax (slot hwm + overflow diagnostics) + the
+    # whole-mesh loop gate traced twice (while seed and loop body)
+    assert got["pmax"] == 3, (
+        f"expected telemetry + seed + loop-gate pmax, got {got['pmax']}"
+    )
+    assert got["gather"] == 0, (
+        f"{got['gather']} gather-the-world collective(s) crept back "
+        "into the node-sharded run program"
     )
 
 
-def test_jax_step_collectives_pinned():
-    """Same pin for the retrofitted ops/step.py path: the sharded step
-    function carries 2*(D-1) ppermutes + 1 psum, no all_gather."""
+# -- multicast INV fan-out --------------------------------------------
+#
+# An invalidation to S sharers living on the same remote shard ships
+# as ONE exchange entry carrying the sharer bitmask and expands
+# shard-locally — exchange_multicast_saved counts the entries NOT
+# shipped (fan - 1 per multicast entry).
+
+
+def test_multicast_expansion_hand_computed():
+    """num_procs=4 over 2 shards: nodes 2 and 3 (both on shard 1) read
+    addr 0 (homed at node 0 on shard 0); after the sharers are
+    registered, node 0 writes it.  The home's invalidation to sharers
+    {2, 3} crosses the shard boundary as ONE bitmask entry that
+    expands to two deliveries — exactly one shipped entry saved."""
+    _require_devices(2)
+    from hpa2_tpu.models.protocol import Instr
+
+    cfg = SystemConfig(num_procs=4, semantics=ROBUST)
+    filler = [Instr("R", 16 + 1, 0)] * 12  # node 1's own home block
+    traces = [
+        [Instr("R", 1, 0)] * 12 + [Instr("W", 0, 77)],  # home writes last
+        list(filler),
+        [Instr("R", 0, 0)],
+        [Instr("R", 0, 0)],
+    ]
+    shd = NodeShardedEngine(
+        cfg, traces, mesh=make_mesh(node_shards=2)
+    ).run()
+    ref = JaxEngine(cfg, traces).run()
+    assert [d.__dict__ for d in shd.final_dumps()] == [
+        d.__dict__ for d in ref.final_dumps()
+    ]
+    assert shd.stats()["exchange_multicast_saved"] == 1, shd.stats()
+
+
+@pytest.mark.parametrize("mode", ["a2a", "hier"])
+def test_invalidation_storm_multicast_savings(mode):
+    """Every node repeatedly reads a block homed at node 0, then the
+    home rewrites it: each rewrite fans an INV to sharers on every
+    remote shard, so the bitmask encoding must save real traffic
+    (exchange_multicast_saved > 0) while dumps stay bit-identical."""
     _require_devices(4)
-    cfg = _cfg()
+    from hpa2_tpu.models.protocol import Instr
+
+    cfg = SystemConfig(num_procs=8, semantics=ROBUST)
+    reads = 3
+    traces = [[] for _ in range(8)]
+    for rnd in range(3):
+        addr = rnd  # all homed at node 0
+        for i in range(1, 8):
+            traces[i] += [Instr("R", addr, 0)] * reads
+        traces[0] += [Instr("R", 16, 0)] * (reads * 3) + [
+            Instr("W", addr, 100 + rnd)
+        ]
+    cfg = dataclasses.replace(cfg, exchange_mode=mode)
+    shd = NodeShardedEngine(
+        cfg, traces, mesh=make_mesh(node_shards=4)
+    ).run()
+    ref = JaxEngine(cfg, traces).run()
+    assert [d.__dict__ for d in shd.final_dumps()] == [
+        d.__dict__ for d in ref.final_dumps()
+    ]
+    assert shd.cycle == ref.cycle
+    stats = shd.stats()
+    assert stats["exchange_multicast_saved"] > 0, stats
+    # the storm also exercises the Pallas path's expansion
+    from hpa2_tpu.utils.trace import traces_to_arrays
+
+    pshd = NodeShardedPallasEngine(
+        cfg, *traces_to_arrays(cfg, [traces]), node_shards=4,
+        snapshots=False, cycles_per_call=16,
+    ).run()
+    assert [d.__dict__ for d in pshd.system_final_dumps(0)] == [
+        d.__dict__ for d in ref.final_dumps()
+    ]
+    assert pshd.stats()["exchange_multicast_saved"] > 0
+
+
+@pytest.mark.parametrize("mode", _MODES)
+def test_jax_step_collectives_pinned(mode):
+    """Same pin for the retrofitted ops/step.py path: the sharded step
+    carries exactly the plan's collectives + 1 stacked counter psum
+    (+ the elision fast-forward's progress psum — elide defaults on)
+    + 1 telemetry pmax, no all_gather."""
+    _require_devices(4)
+    cfg = dataclasses.replace(_cfg(), exchange_mode=mode)
     traces = gen_uniform_random(cfg, 12, seed=7)
     eng = NodeShardedEngine(
         cfg, traces, mesh=make_mesh(node_shards=4)
@@ -306,9 +448,10 @@ def test_jax_step_collectives_pinned():
     jx = jax.make_jaxpr(eng._run)(eng.state).jaxpr
     bodies = _find_subjaxprs(jx, "shard_map")
     assert bodies, "node-sharded jax run lost its shard_map"
-    n_permute = sum(_count_prims(b, ("ppermute",)) for b in bodies)
-    n_psum = sum(_count_prims(b, _PSUM_PRIMS) for b in bodies)
-    n_gather = sum(_count_prims(b, _GATHER_PRIMS) for b in bodies)
-    assert n_permute == 2 * 3
-    assert n_psum == 1
-    assert n_gather == 0
+    got = _collective_counts(bodies)
+    plan = exchange.plan_collectives(exchange.make_plan(4, mode, 0))
+    assert got["ppermute"] == plan["ppermute"]
+    assert got["all_to_all"] == plan["all_to_all"]
+    assert got["psum"] == 2
+    assert got["pmax"] == 1
+    assert got["gather"] == 0
