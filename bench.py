@@ -694,6 +694,122 @@ def serve_child_main(platform: str) -> int:
     return 0
 
 
+def failover_child_main(platform: str) -> int:
+    """``bench.py --failover`` child: recovery-latency numbers for the
+    fault-tolerance supervisor (one JSON line):
+
+    1. unfailed baseline serve -> wall time + dump transcript,
+    2. one supervised run per failure kind (kill / hang / poison at
+       the same interval barrier) -> recovery overhead vs baseline,
+       recovery counters, and the byte-identity check against the
+       unfailed dumps,
+    3. a mid-frame wire sever against a live framed server -> the
+       client-observed blackout (disconnect + backoff + reconnect +
+       session resume) and the idempotent-resubmit check.
+    """
+    import tempfile
+    import threading
+
+    from hpa2_tpu.config import FailurePlan
+    from hpa2_tpu.service import WireClient, WireJobSource
+    from hpa2_tpu.serving import (
+        ListJobSource, job_to_record, serve, supervised_serve,
+        synthetic_jobs)
+
+    config = _bench_config()
+    on_tpu = platform == "tpu"
+    (resident, jobs_n, instrs, window, block, policy,
+     backend) = _serve_knobs(on_tpu)
+    try:
+        fail_at = int(os.environ.get("HPA2_FAILOVER_AT", "3"))
+    except ValueError:
+        fail_at = 3
+
+    kw = dict(backend=backend, resident=resident, window=window,
+              block=block, policy=policy, max_trace_len=instrs,
+              decode_dumps=False)
+    jobs = synthetic_jobs(config, jobs_n, instrs, seed=0, dist="zipf",
+                          spread=4.0)
+
+    def _dump_map(res):
+        return {r.job_id: tuple(repr(d) for d in r.dumps)
+                for r in res}
+
+    # warmup the jit caches, then the unfailed baseline
+    serve(config,
+          ListJobSource(synthetic_jobs(
+              config, min(jobs_n, 2 * resident), instrs, seed=99,
+              dist="zipf", spread=4.0)), **kw)
+    t0 = time.perf_counter()
+    base_res, _ = serve(config, ListJobSource(jobs), **kw)
+    base_wall = time.perf_counter() - t0
+    want = _dump_map(base_res)
+
+    runs = {}
+    for kind, spec in (("kill", f"kill@{fail_at}"),
+                       ("hang", f"hang@{fail_at}"),
+                       ("poison", f"poison@{fail_at}:1")):
+        plan = FailurePlan.parse(spec, seed=11)
+        with tempfile.TemporaryDirectory() as td:
+            t0 = time.perf_counter()
+            res, st = supervised_serve(
+                config, ListJobSource(jobs), plan=plan,
+                checkpoint_dir=td, **kw)
+            wall = time.perf_counter() - t0
+        rec = dict(st.occupancy.get("recovery", {}))
+        rec.pop("events", None)
+        runs[kind] = {
+            "wall_s": round(wall, 4),
+            "recovery_overhead_s": round(max(0.0, wall - base_wall), 4),
+            "byte_identical": _dump_map(res) == want,
+            **rec,
+        }
+
+    # wire-layer blackout: sever the connection mid-ACK at seq 2, let
+    # the client ride retry/backoff + session resume back in
+    sever_plan = FailurePlan.parse("sever@2", seed=7)
+    src = WireJobSource(config, failures=sever_plan)
+    recs = [job_to_record(j) for j in jobs[:min(8, len(jobs))]]
+    blackout = {}
+
+    def client():
+        cli = WireClient(*src.address, timeout_s=30.0, retries=4,
+                         backoff_s=0.02, backoff_seed=11)
+        worst = 0.0
+        for r in recs:
+            t0 = time.perf_counter()
+            cli.submit(r)
+            worst = max(worst, time.perf_counter() - t0)
+        cli.finish()
+        blackout["blackout_s"] = round(worst, 4)
+        blackout["client_retries"] = cli.retries
+        blackout["session"] = cli.session
+        cli.close()
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    serve(config, src, emit=src.deliver, **kw)
+    t.join(timeout=120)
+
+    result = {
+        "metric": "failover_recovery_overhead_s",
+        "value": runs["kill"]["recovery_overhead_s"],
+        "unit": "seconds",
+        "platform": platform,
+        "indicative": on_tpu,
+        "backend": backend,
+        "resident": resident,
+        "jobs": jobs_n,
+        "instrs_per_core": instrs,
+        "fail_at_interval": fail_at,
+        "baseline_wall_s": round(base_wall, 4),
+        "runs": runs,
+        "wire_sever": blackout,
+    }
+    print(json.dumps(result))
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # parent: platform probe + subprocess orchestration, always one JSON line
 # ---------------------------------------------------------------------------
@@ -859,6 +975,34 @@ def _run_child(platform: str, timeout_s: int, pallas_ok: bool,
                 continue
     print(f"{platform} bench child: rc={proc.returncode}, no JSON line",
           file=sys.stderr)
+    return None
+
+
+def _run_failover_child(platform: str, timeout_s: int):
+    """Run the failover-benchmark child; parsed JSON dict or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--child-failover", platform],
+            env=_child_env(platform),
+            cwd=_REPO_ROOT,
+            timeout=timeout_s,
+            capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"{platform} failover child: timeout ({timeout_s}s)",
+              file=sys.stderr)
+        return None
+    sys.stderr.write(_filter_xla_spew(proc.stderr.decode(errors="replace")))
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    print(f"{platform} failover child: rc={proc.returncode}, no JSON "
+          "line", file=sys.stderr)
     return None
 
 
@@ -1149,6 +1293,32 @@ def serve_main() -> int:
     return 0
 
 
+def failover_main() -> int:
+    """``bench.py --failover``: the fault-tolerance benchmark, same
+    probe-in-subprocess discipline as the headline bench; always one
+    JSON line."""
+    tpu_ok = _probe_tpu()
+    result = None
+    if tpu_ok:
+        result = _run_failover_child("tpu", _TPU_CHILD_TIMEOUT_S)
+    if result is None:
+        result = _run_failover_child("cpu", _CPU_CHILD_TIMEOUT_S)
+        if result is not None and tpu_ok:
+            result["note"] = "tpu failover child failed; cpu smoke result"
+    if result is None:
+        result = {
+            "metric": "failover_recovery_overhead_s",
+            "value": None,
+            "unit": "seconds",
+            "platform": None,
+            "indicative": False,
+            "note": "all failover bench paths failed (tpu probe "
+                    f"{'ok' if tpu_ok else 'failed'}; see stderr)",
+        }
+    print(json.dumps(result))
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) >= 2 and sys.argv[1] == "--compile-gate":
         return compile_gate_main()
@@ -1160,6 +1330,8 @@ def main() -> int:
         )
     if len(sys.argv) >= 3 and sys.argv[1] == "--child-serve":
         return serve_child_main(sys.argv[2])
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child-failover":
+        return failover_child_main(sys.argv[2])
     if "--data-shards" in sys.argv:
         # split the ensemble over N local devices (DataShardedPallasEngine);
         # carried to the children via the environment
@@ -1252,6 +1424,11 @@ def main() -> int:
         # HPA2_SERVE_* env knobs; --data-shards composes (dispatched
         # after the argv->env parsing above so it takes effect)
         return serve_main()
+    if "--failover" in sys.argv:
+        # fault-tolerance benchmark (ISSUE 16): recovery latency per
+        # failure kind + wire-sever blackout; sized via HPA2_SERVE_* /
+        # HPA2_FAILOVER_AT
+        return failover_main()
     if "--topology" in sys.argv:
         # interconnect sensitivity study (ISSUE 11): sized via the
         # HPA2_TOPO_* env knobs; model output, spec/XLA cross-checked

@@ -523,7 +523,14 @@ def cmd_bench(args) -> int:
                 state, ck_config, ck_meta = load_state(
                     resume, with_meta=True
                 )
-                if ck_config != config or ck_meta != workload_meta:
+                # schema-v2 checkpoints always carry the recovery
+                # counters in meta; they are history, not workload
+                # identity, so they don't participate in the staleness
+                # check
+                ck_workload = {
+                    k: v for k, v in ck_meta.items() if k != "recovery"
+                }
+                if ck_config != config or ck_workload != workload_meta:
                     raise SystemExit(
                         f"checkpoint {resume} was written for a "
                         "different config/workload; use a fresh "
@@ -659,6 +666,43 @@ def cmd_serve(args) -> int:
     except ValueError as e:
         raise SystemExit(f"--tenant-weights: {e}")
 
+    plan = None
+    if args.failure_plan:
+        import dataclasses
+
+        from hpa2_tpu.config import FailurePlan
+
+        try:
+            plan = FailurePlan.parse(
+                args.failure_plan, seed=args.failure_seed)
+        except ValueError as e:
+            raise SystemExit(f"--failure-plan: {e}")
+        # the plan is config data: record it where checkpoints (and
+        # anything else hashing the run) can see it
+        config = dataclasses.replace(config, failures=plan)
+
+    targets = None
+    if args.migrate_to:
+        targets = []
+        for part in args.migrate_to.split(","):
+            bits = part.strip().split(":")
+            if not bits[0] or bits[0] not in ("jax", "pallas"):
+                raise SystemExit(
+                    "--migrate-to takes backend[:data_shards"
+                    "[:node_shards]] entries (backend jax|pallas)")
+            t = {"backend": bits[0]}
+            try:
+                if len(bits) > 1:
+                    t["data_shards"] = int(bits[1])
+                if len(bits) > 2:
+                    t["node_shards"] = int(bits[2])
+                    if t["node_shards"] > 1:
+                        t["backend"] = "pallas-node-sharded"
+            except ValueError:
+                raise SystemExit(f"--migrate-to: bad shard count in "
+                                 f"{part!r}")
+            targets.append(t)
+
     wire_source = None
     if args.listen:
         host, _, port = args.listen.rpartition(":")
@@ -672,6 +716,9 @@ def cmd_serve(args) -> int:
             source = wire_source = WireJobSource(
                 config, host or "127.0.0.1", port_n,
                 credits=args.credits, tenants=tenants,
+                shed_threshold=args.shed_threshold,
+                heartbeat_s=args.heartbeat,
+                failures=plan,
             )
             print(
                 f"[serve] framed wire on "
@@ -711,8 +758,23 @@ def cmd_serve(args) -> int:
         if wire_source is not None:
             wire_source.deliver(res)
 
+    serve_fn = serve
+    serve_kw = {}
+    supervised = (plan is not None and plan.enabled
+                  ) or args.checkpoint_dir is not None
+    if supervised:
+        from hpa2_tpu.serving import supervised_serve
+
+        serve_fn = supervised_serve
+        if args.checkpoint_dir:
+            os.makedirs(args.checkpoint_dir, exist_ok=True)
+        serve_kw = dict(
+            plan=plan, targets=targets,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+        )
     try:
-        _, stats = serve(
+        _, stats = serve_fn(
             config, source,
             backend=backend,
             resident=args.resident,
@@ -728,6 +790,7 @@ def cmd_serve(args) -> int:
             decode_dumps=bool(out),
             emit=emit,
             tenant_weights=tenants.weights or None,
+            **serve_kw,
         )
     finally:
         source.close()
@@ -1038,6 +1101,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--results-jsonl", default=None, metavar="PATH",
         help="stream one JSON result record (latency, counters) per "
         "completed job",
+    )
+    fp = sp.add_argument_group("fault tolerance")
+    fp.add_argument(
+        "--failure-plan", default=None, metavar="SPEC",
+        help="seeded failure injection: 'kind@interval[:target]' "
+        "events joined by ';' — kinds kill (backend dies at the "
+        "interval barrier), hang (shard stalls; the watchdog "
+        "detects), poison (lane block corrupted; re-run same spec), "
+        "sever (wire connection cut mid-frame at ack seq TARGET). "
+        "Arms the recovery supervisor (checkpointed live migration)",
+    )
+    fp.add_argument("--failure-seed", type=int, default=0,
+                    help="seed folded into the failure plan (jitters "
+                    "client backoff; the plan itself is deterministic)")
+    fp.add_argument(
+        "--migrate-to", default=None, metavar="B[:D[:N]],...",
+        help="migration target rotation: backend[:data_shards"
+        "[:node_shards]] entries tried in order on each kill/hang "
+        "(default: cross the pallas<->jax divide)",
+    )
+    fp.add_argument(
+        "--checkpoint-dir", default=None, metavar="DIR",
+        help="supervisor checkpoints (schema-v2 npz mid-state on the "
+        "jax backend, JSON manifests on pallas) land here every "
+        "--checkpoint-every interval barriers",
+    )
+    fp.add_argument("--checkpoint-every", type=int, default=1,
+                    metavar="K")
+    fp.add_argument(
+        "--shed-threshold", type=int, default=0, metavar="N",
+        help="--wire: graceful degradation — once N jobs are pending, "
+        "batch-class SUBMITs draw a structured 'shed' NACK instead of "
+        "queueing (interactive traffic keeps flowing)",
+    )
+    fp.add_argument(
+        "--heartbeat", type=float, default=0.0, metavar="SECS",
+        help="--wire: emit HEARTBEAT frames to idle connections every "
+        "SECS seconds so clients can tell a slow server from a dead "
+        "one",
     )
     _add_common(sp)
     sp.set_defaults(fn=cmd_serve)

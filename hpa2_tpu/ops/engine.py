@@ -1004,12 +1004,23 @@ class BatchLaneSession:
         interval: int = 256,
         max_cycles: int = 1_000_000,
         data_shards: int = 1,
+        window: Optional[int] = None,
     ):
         self.config = config
         self.r = int(resident)
         self._max_t = int(max_trace_len)
         self.interval = max(1, int(interval))
         self.max_cycles = max_cycles
+        # window schedule emulation (ISSUE-16): ``window=w`` replays
+        # the Pallas path's segment schedule — each row sees its trace
+        # clipped to successive w-entry windows with a quiescence
+        # barrier between (the serving loop extends via
+        # ``window_extend``), so a job migrated pallas -> jax keeps
+        # byte-identical dumps.  ``None`` (the default) is the native
+        # unwindowed schedule — existing behavior, untouched.
+        self.window = None if window is None else max(1, int(window))
+        self._full_len = np.zeros((self.r, config.num_procs), np.int32)
+        self._seg = np.ones(self.r, np.int64)
         self.mesh = None
         if data_shards != 1:
             from hpa2_tpu.parallel.sharding import (
@@ -1060,6 +1071,37 @@ class BatchLaneSession:
         )
 
     def admit(self, idx: int, row: SimState) -> None:
+        if self.window is not None:
+            full = np.asarray(row.tr_len, np.int32)
+            self._full_len[idx] = full
+            self._seg[idx] = 1
+            row = row._replace(tr_len=jnp.asarray(
+                np.minimum(full, self.window), jnp.int32))
+        self.state = self._place(
+            self._admit_jit(self.state, jnp.int32(idx), row)
+        )
+
+    def window_done(self, idx: int) -> bool:
+        """Under window emulation: is a quiescent row truly finished
+        (every node's full trace visible), or just at a barrier?"""
+        if self.window is None:
+            return True
+        return bool(
+            (self._seg[idx] * self.window >= self._full_len[idx]).all()
+        )
+
+    def window_extend(self, idx: int) -> None:
+        """Cross one window barrier: reveal the next ``window`` trace
+        entries to a quiescent row (take → bump tr_len → re-admit;
+        the quiescent state is a fixed point, so where the chunk
+        boundary falls never changes the result)."""
+        self._seg[idx] += 1
+        clip = np.minimum(
+            self._full_len[idx],
+            self._seg[idx] * self.window,
+        ).astype(np.int32)
+        row = self._take_jit(self.state, jnp.int32(idx))
+        row = row._replace(tr_len=jnp.asarray(clip, jnp.int32))
         self.state = self._place(
             self._admit_jit(self.state, jnp.int32(idx), row)
         )

@@ -227,6 +227,10 @@ class OccupancyStats:
     deadline_met: int = 0
     deadline_missed: int = 0
     tenant_live: Dict[int, int] = dataclasses.field(default_factory=dict)
+    #: graceful-degradation counter (ISSUE-16): batch-class jobs the
+    #: admission ledger shed under overload.  Absent from ``as_dict``
+    #: when zero so every pre-shedding artifact is byte-identical.
+    shed_jobs: int = 0
 
     @property
     def mean_live_fraction(self) -> float:
@@ -278,6 +282,8 @@ class OccupancyStats:
             out["deadline_met"] = self.deadline_met
             out["deadline_missed"] = self.deadline_missed
             out["deadline_hit_rate"] = round(self.deadline_met / total, 4)
+        if self.shed_jobs:
+            out["shed_jobs"] = self.shed_jobs
         if self.tenant_live:
             total = sum(self.tenant_live.values())
             out["tenant_share"] = {

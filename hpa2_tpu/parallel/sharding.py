@@ -131,6 +131,15 @@ def _place(state: SimState, mesh: Mesh, specs: SimState) -> SimState:
     )
 
 
+def fetch_host_state(state: SimState) -> SimState:
+    """Gather a (possibly sharded) device state tree onto the host as
+    plain numpy — the barrier snapshot the recovery supervisor hands
+    to ``save_state``.  Works for single-device, data-sharded and
+    node-sharded layouts alike (``np.asarray`` forces the cross-shard
+    gather)."""
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), state)
+
+
 @functools.lru_cache(maxsize=16)
 def build_node_sharded_run(
     config: SystemConfig,

@@ -9,7 +9,11 @@
   by ack sequence.
 - :mod:`hpa2_tpu.service.frontend` — :class:`WireJobSource`, the
   framed TCP listener the serving loop polls; results stream back to
-  the owning connection.
+  the owning *session* (HELLO-negotiated ids that survive reconnects).
+- :mod:`hpa2_tpu.service.failover` — deterministic failure injection
+  above the link layer (:class:`FailureInjector` driving the seeded
+  :class:`~hpa2_tpu.config.FailurePlan`) and the structured recovery
+  log the supervisor publishes.
 
 Quick start (server side)::
 
@@ -31,17 +35,22 @@ and the client::
 """
 
 from hpa2_tpu.service.admission import (
-    DEADLINE_CLASSES, AdmissionLedger, AdmissionReject, TenantTable,
-    resolve_deadline)
+    DEADLINE_CLASSES, AdmissionLedger, AdmissionReject, AdmissionShed,
+    TenantTable, resolve_deadline)
+from hpa2_tpu.service.failover import (
+    FailureInjector, InjectedFailure, RecoveryLog, recovery_record)
 from hpa2_tpu.service.frontend import WireJobSource
 from hpa2_tpu.service.wire import (
-    ACK, BYE, CREDIT, EOF, HELLO, NACK, RESULT, SUBMIT, Frame,
-    FrameReader, WireClient, WireError, WireNack, encode_frame)
+    ACK, BYE, CREDIT, EOF, HEARTBEAT, HELLO, NACK, RESULT, SUBMIT,
+    ConnectionLost, Frame, FrameReader, WireClient, WireError,
+    WireNack, backoff_delay, encode_frame)
 
 __all__ = [
     "ACK", "BYE", "CREDIT", "DEADLINE_CLASSES", "EOF", "Frame",
-    "FrameReader", "HELLO", "NACK", "RESULT", "SUBMIT",
-    "AdmissionLedger", "AdmissionReject", "TenantTable", "WireClient",
-    "WireError", "WireJobSource", "WireNack", "encode_frame",
-    "resolve_deadline",
+    "FrameReader", "HEARTBEAT", "HELLO", "NACK", "RESULT", "SUBMIT",
+    "AdmissionLedger", "AdmissionReject", "AdmissionShed",
+    "ConnectionLost", "FailureInjector", "InjectedFailure",
+    "RecoveryLog", "TenantTable", "WireClient", "WireError",
+    "WireJobSource", "WireNack", "backoff_delay", "encode_frame",
+    "recovery_record", "resolve_deadline",
 ]

@@ -97,6 +97,13 @@ class AdmissionReject(Exception):
     reason sent back on the wire."""
 
 
+class AdmissionShed(AdmissionReject):
+    """A submission dropped by graceful degradation: the ledger is over
+    its shed threshold and the job is batch-class (no deadline), so it
+    is shed first to protect deadline traffic.  The NACK carries
+    ``"shed": true`` — the client may safely resubmit later."""
+
+
 @dataclasses.dataclass
 class _Pending:
     seq: int
@@ -112,33 +119,69 @@ class AdmissionLedger:
     calls :meth:`take_wave`.  Credits bound how far a connection may
     run ahead of admission: each accepted SUBMIT consumes one, each
     job drained by ``take_wave`` returns one to its connection (the
-    frontend turns those into CREDIT frames)."""
+    frontend turns those into CREDIT frames).
 
-    def __init__(self, credits: int = 64):
+    ``shed_threshold > 0`` arms graceful degradation: once the pending
+    queue reaches the threshold, batch-class submissions (resolved
+    deadline -1) are shed with :class:`AdmissionShed` — deadline
+    traffic keeps admitting until credits push back.  ``shed_jobs``
+    counts them for the occupancy model.
+
+    Connection keys are opaque hashables: the framed frontend keys the
+    ledger by *session* id so admissions survive a TCP reconnect
+    (:meth:`transfer` re-points a balance when a resumed session
+    changes key), and :meth:`ack_of` replays the original ack of a job
+    this ledger already admitted — the idempotent-SUBMIT half of
+    session resume."""
+
+    def __init__(self, credits: int = 64, shed_threshold: int = 0):
         if credits <= 0:
             raise ValueError(f"credits must be > 0, got {credits}")
+        if shed_threshold < 0:
+            raise ValueError(
+                f"shed_threshold must be >= 0, got {shed_threshold}")
         self.credits = int(credits)
+        self.shed_threshold = int(shed_threshold)
+        self.shed_jobs = 0
         self._lock = threading.Lock()
         self._seq = 0
         self._pending: List[_Pending] = []
         self._seen_ids: set = set()
-        self._conn_credits: Dict[int, int] = {}
+        self._acks: Dict[str, Tuple[int, int]] = {}
+        self._conn_credits: Dict = {}
 
     # -- connection lifecycle -----------------------------------------
 
-    def register(self, conn: int) -> int:
+    def register(self, conn) -> int:
         """A new connection: returns its starting credit budget."""
         with self._lock:
             self._conn_credits[conn] = self.credits
             return self.credits
 
-    def forget(self, conn: int) -> None:
+    def forget(self, conn) -> None:
         with self._lock:
             self._conn_credits.pop(conn, None)
 
+    def balance(self, conn) -> int:
+        """The connection's current credit balance (0 if unknown)."""
+        with self._lock:
+            return self._conn_credits.get(conn, 0)
+
+    def transfer(self, old, new) -> int:
+        """Re-point a credit balance (and pending entries) from key
+        ``old`` to key ``new`` — a session resuming under a different
+        ledger key keeps its admission state.  Returns the balance."""
+        with self._lock:
+            bal = self._conn_credits.pop(old, self.credits)
+            self._conn_credits[new] = bal
+            for p in self._pending:
+                if p.conn == old:
+                    p.conn = new
+            return bal
+
     # -- the submit side (reader threads) ------------------------------
 
-    def try_submit(self, conn: int, record: dict) -> Tuple[int, int]:
+    def try_submit(self, conn, record: dict) -> Tuple[int, int]:
         """Admit one record: returns ``(seq, queue_pos)`` or raises
         :class:`AdmissionReject` with the NACK reason."""
         job_id = record.get("id")
@@ -149,7 +192,7 @@ class AdmissionLedger:
                 f"job {job_id!r} needs exactly one of 'traces'/'workload'"
             )
         try:
-            resolve_deadline(record)
+            deadline = resolve_deadline(record)
         except ValueError as e:
             raise AdmissionReject(str(e)) from None
         with self._lock:
@@ -161,12 +204,29 @@ class AdmissionLedger:
                 )
             if job_id in self._seen_ids:
                 raise AdmissionReject(f"duplicate job id {job_id!r}")
+            if (self.shed_threshold
+                    and len(self._pending) >= self.shed_threshold
+                    and deadline < 0):
+                self.shed_jobs += 1
+                raise AdmissionShed(
+                    f"overload: shedding batch-class job {job_id!r} "
+                    f"({len(self._pending)} pending >= "
+                    f"{self.shed_threshold} threshold)"
+                )
             self._conn_credits[conn] = left - 1
             self._seen_ids.add(job_id)
             seq = self._seq
             self._seq += 1
             self._pending.append(_Pending(seq, conn, record))
+            self._acks[str(job_id)] = (seq, len(self._pending) - 1)
             return seq, len(self._pending) - 1
+
+    def ack_of(self, job_id: str) -> Optional[Tuple[int, int]]:
+        """The ``(seq, queue_pos)`` this ledger originally acked for an
+        already-admitted job id, or None — lets the frontend replay an
+        ack for an idempotent resubmit instead of NACKing it."""
+        with self._lock:
+            return self._acks.get(str(job_id))
 
     # -- the drain side (the serving loop's poll) ----------------------
 

@@ -5,6 +5,9 @@
   sources and seeded arrival processes.
 - :mod:`hpa2_tpu.serving.loop` — the serving loop itself: trace pool,
   overlapped admission pipeline, phase timers, zero-recompile guard.
+- :mod:`hpa2_tpu.serving.recovery` — the fault-tolerance supervisor:
+  checkpointed live migration / evacuation between backends and shard
+  counts under a seeded :class:`~hpa2_tpu.config.FailurePlan`.
 
 Quick start::
 
@@ -22,13 +25,15 @@ from hpa2_tpu.serving.jobs import (
     parse_jobs_lines, synthetic_jobs)
 from hpa2_tpu.serving.loop import (
     BatchServingSession, ServingSession, ServingStats, TracePool,
-    serve)
+    build_serving, serve)
+from hpa2_tpu.serving.recovery import (
+    ServeSupervisor, default_targets, supervised_serve)
 
 __all__ = [
     "BatchServingSession", "FileJobSource", "Job", "JobResult",
-    "JobSource", "ListJobSource", "ServingSession", "ServingStats",
-    "SocketJobSource", "TracePool", "job_from_record",
-    "job_to_record", "load_jobs_file", "parse_jobs_lines",
-    "poisson_arrivals", "serve", "synthetic_jobs",
-    "zipf_burst_arrivals",
+    "JobSource", "ListJobSource", "ServeSupervisor", "ServingSession",
+    "ServingStats", "SocketJobSource", "TracePool", "build_serving",
+    "default_targets", "job_from_record", "job_to_record",
+    "load_jobs_file", "parse_jobs_lines", "poisson_arrivals", "serve",
+    "supervised_serve", "synthetic_jobs", "zipf_burst_arrivals",
 ]

@@ -270,9 +270,12 @@ class ServingSession:
         compile_guard: bool = True,
         backend: str = "pallas",
         tenant_weights: Optional[Dict[str, float]] = None,
+        interval_hook: Optional[Callable[[int, "ServingSession"],
+                                         None]] = None,
     ):
         self.session = session
         self.source = source
+        self.interval_hook = interval_hook
         # tenant names -> dense integer ids, default tenant "" first;
         # the id-keyed weight dict is handed to the scheduler BY
         # REFERENCE so names first seen later still order correctly
@@ -438,9 +441,15 @@ class ServingSession:
             else:
                 self._sync(status)
                 self._drain(pending)
+            if self.interval_hook is not None:
+                # the supervisor's tap: interval k's barrier has been
+                # applied — checkpoint/failure-injection point
+                self.interval_hook(sched.stats.intervals, self)
         self._sync(prev_status)
         self._drain(prev_pending)
         st.wall_s = time.perf_counter() - wall0
+        sched.stats.shed_jobs = int(
+            getattr(self.source, "shed_jobs", 0) or 0)
         st.occupancy = sched.stats.set_mode(fused=False).as_dict()
         st.compile_counts = sess.compile_counts()
         _guard_compiles(st.compile_counts, self.compile_guard)
@@ -467,9 +476,12 @@ BatchLaneSession` rows.  Row completion is device quiescence, so the
         compile_guard: bool = True,
         backend: str = "jax",
         tenant_weights: Optional[Dict[str, float]] = None,
+        interval_hook: Optional[Callable[[int, "BatchServingSession"],
+                                         None]] = None,
     ):
         self.session = session
         self.source = source
+        self.interval_hook = interval_hook
         self.policy = policy
         self.overlap = overlap
         self.decode_dumps = decode_dumps
@@ -563,6 +575,15 @@ BatchLaneSession` rows.  Row completion is device quiescence, so the
         done_rows = [
             int(i) for i in np.nonzero((row_sys >= 0) & quiet)[0]
         ]
+        if getattr(sess, "window", None) is not None:
+            # window-schedule emulation: a quiescent row at a window
+            # barrier extends instead of retiring (and made progress,
+            # so its stall-watchdog age resets)
+            barrier = [i for i in done_rows if not sess.window_done(i)]
+            for i in barrier:
+                sess.window_extend(i)
+                self._row_age[i] = 0
+            done_rows = [i for i in done_rows if i not in barrier]
         if not done_rows:
             return
         t0 = time.perf_counter()
@@ -635,6 +656,12 @@ BatchLaneSession` rows.  Row completion is device quiescence, so the
         queue: deque = deque()
         enq_at: Dict[int, int] = {}
         wait_of: Dict[int, int] = {}
+        # live handles for the recovery supervisor: the interval hook
+        # reads these to checkpoint mid-run state at chunk barriers
+        self.row_sys = row_sys
+        self.wait_of = wait_of
+        self.occ = occ
+        self._row_age = row_age
         chunk = 0
         wall0 = time.perf_counter()
         while True:
@@ -655,6 +682,8 @@ BatchLaneSession` rows.  Row completion is device quiescence, so the
                 chunk += 1
                 self._account_chunk(occ, row_sys, row_age, queue)
                 self._harvest(row_sys, quiet, wait_of, occ, chunk)
+                if self.interval_hook is not None:
+                    self.interval_hook(chunk, self)
             else:
                 staged = self._stage(queue, free)
             for idx, s, row in staged:
@@ -678,14 +707,17 @@ BatchLaneSession` rows.  Row completion is device quiescence, so the
                 chunk += 1
                 self._account_chunk(occ, row_sys, row_age, queue)
                 self._harvest(row_sys, quiet, wait_of, occ, chunk)
+                if self.interval_hook is not None:
+                    self.interval_hook(chunk, self)
         st.wall_s = time.perf_counter() - wall0
+        occ.shed_jobs = int(getattr(self.source, "shed_jobs", 0) or 0)
         st.occupancy = occ.as_dict()
         st.compile_counts = sess.compile_counts()
         _guard_compiles(st.compile_counts, self.compile_guard)
         return self.results, st
 
 
-def serve(
+def build_serving(
     config: SystemConfig,
     source: JobSource,
     *,
@@ -706,13 +738,13 @@ def serve(
     compile_guard: bool = True,
     interpret: Optional[bool] = None,
     tenant_weights: Optional[Dict[str, float]] = None,
-) -> Tuple[List[JobResult], ServingStats]:
-    """Build the right resident session for ``backend`` and drive the
-    source to exhaustion.  Backends: ``pallas`` (the fast path),
-    ``pallas-sharded`` (data-parallel lanes over ``data_shards``
-    devices), ``pallas-node-sharded`` (each system's node axis split
-    over ``node_shards`` devices — jobs bigger than a chip), ``jax``
-    (the XLA batch engine — the only backend with fault injection)."""
+    interval_hook: Optional[Callable] = None,
+    jax_window: Optional[int] = None,
+):
+    """Build the right resident session + serving driver for
+    ``backend`` without running it — the recovery supervisor uses this
+    to keep the driver handle (and its interval hook) while a plain
+    ``serve()`` is just ``build_serving(...).run()``."""
     if backend == "pallas":
         from hpa2_tpu.ops.pallas_engine import PallasLaneSession
 
@@ -724,7 +756,7 @@ def serve(
             sess, source, policy=policy, threshold=threshold,
             overlap=overlap, decode_dumps=decode_dumps, emit=emit,
             compile_guard=compile_guard, backend=backend,
-            tenant_weights=tenant_weights,
+            tenant_weights=tenant_weights, interval_hook=interval_hook,
         )
     elif backend == "pallas-sharded":
         from hpa2_tpu.parallel.sharding import DataShardedLaneSession
@@ -739,7 +771,7 @@ def serve(
             threshold=threshold, overlap=overlap,
             decode_dumps=decode_dumps, emit=emit,
             compile_guard=compile_guard, backend=backend,
-            tenant_weights=tenant_weights,
+            tenant_weights=tenant_weights, interval_hook=interval_hook,
         )
     elif backend == "pallas-node-sharded":
         from hpa2_tpu.parallel.sharding import NodeShardedLaneSession
@@ -754,24 +786,41 @@ def serve(
             threshold=threshold, overlap=overlap,
             decode_dumps=decode_dumps, emit=emit,
             compile_guard=compile_guard, backend=backend,
-            tenant_weights=tenant_weights,
+            tenant_weights=tenant_weights, interval_hook=interval_hook,
         )
     elif backend == "jax":
         from hpa2_tpu.ops.engine import BatchLaneSession
 
+        # jax_window opts the batch engine into the Pallas window
+        # schedule (quiescence barrier every jax_window trace entries)
+        # so a migrated job's dumps stay byte-identical to the pallas
+        # run; None (default) keeps the native unwindowed schedule
         sess = BatchLaneSession(
             config, resident, max_trace_len, interval=interval,
             max_cycles=max_cycles, data_shards=data_shards,
+            window=jax_window,
         )
         drv = BatchServingSession(
             sess, source, policy=policy, overlap=overlap,
             decode_dumps=decode_dumps, emit=emit,
             compile_guard=compile_guard, backend=backend,
-            tenant_weights=tenant_weights,
+            tenant_weights=tenant_weights, interval_hook=interval_hook,
         )
     else:
         raise ValueError(
             f"unknown serving backend {backend!r}; expected "
             "pallas | pallas-sharded | pallas-node-sharded | jax"
         )
-    return drv.run()
+    return drv
+
+
+def serve(config: SystemConfig, source: JobSource,
+          **kwargs) -> Tuple[List[JobResult], ServingStats]:
+    """Build the right resident session for ``backend`` and drive the
+    source to exhaustion.  Backends: ``pallas`` (the fast path),
+    ``pallas-sharded`` (data-parallel lanes over ``data_shards``
+    devices), ``pallas-node-sharded`` (each system's node axis split
+    over ``node_shards`` devices — jobs bigger than a chip), ``jax``
+    (the XLA batch engine).  Accepts every :func:`build_serving`
+    keyword."""
+    return build_serving(config, source, **kwargs).run()

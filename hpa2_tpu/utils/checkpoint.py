@@ -27,6 +27,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from hpa2_tpu.config import (
+    FailureEvent,
+    FailurePlan,
     FaultModel,
     InterconnectConfig,
     Semantics,
@@ -36,6 +38,16 @@ from hpa2_tpu.ops.state import SimState
 
 _MAGIC = "hpa2_checkpoint_v1"
 _SPEC_MAGIC = "hpa2_spec_checkpoint_v1"
+
+# Checkpoint metadata schema: v2 (ISSUE-16) adds the recovery counters
+# to ``extra_meta["recovery"]``.  v1 files (no ``meta_version`` array)
+# still load — the counters are zero-backfilled, mirroring the PR-15
+# exchange-counter backfill for SimState fields.
+_META_VERSION = 2
+
+#: Supervisor recovery counters carried in checkpoint metadata since
+#: schema v2; absent (= zero) in every older checkpoint.
+RECOVERY_COUNTERS = ("migrations", "evacuations", "shed_jobs", "retries")
 
 # Replicated telemetry counters that may be absent from checkpoints
 # written before they existed; zero-backfilled on load.
@@ -58,6 +70,12 @@ def _config_from_json(text: str) -> SystemConfig:
         ic = dict(d["interconnect"])
         ic["fault"] = FaultModel(**ic["fault"])
         d["interconnect"] = InterconnectConfig(**ic)
+    if d.get("failures") is not None:  # absent pre-ISSUE-16
+        fp = dict(d["failures"])
+        fp["events"] = tuple(
+            FailureEvent(**ev) for ev in fp.get("events", ())
+        )
+        d["failures"] = FailurePlan(**fp)
     return SystemConfig(**d)
 
 
@@ -75,8 +93,17 @@ def save_state(
         for name, leaf in zip(SimState._fields, state)
     }
     arrays["meta_magic"] = np.array(_MAGIC)
+    arrays["meta_version"] = np.array(_META_VERSION)
     arrays["meta_config"] = np.array(_config_to_json(config))
-    arrays["meta_extra"] = np.array(json.dumps(extra_meta or {}))
+    extra = dict(extra_meta or {})
+    # schema v2: the recovery counters always travel, zero-defaulted,
+    # under extra["recovery"] so resumed runs keep their failover
+    # history
+    rec = dict(extra.get("recovery") or {})
+    for name in RECOVERY_COUNTERS:
+        rec.setdefault(name, 0)
+    extra["recovery"] = rec
+    arrays["meta_extra"] = np.array(json.dumps(extra))
     buf = io.BytesIO()
     np.savez_compressed(buf, **arrays)
     tmp = path + ".tmp"
@@ -91,8 +118,20 @@ def load_state(path: str, with_meta: bool = False):
     with np.load(path) as z:
         if str(z["meta_magic"]) != _MAGIC:
             raise ValueError(f"{path}: not a hpa2 checkpoint")
+        version = int(z["meta_version"]) if "meta_version" in z else 1
+        if version > _META_VERSION:
+            raise ValueError(
+                f"{path}: checkpoint schema v{version} is newer than "
+                f"this build's v{_META_VERSION}"
+            )
         config = _config_from_json(str(z["meta_config"]))
         extra = json.loads(str(z["meta_extra"])) if "meta_extra" in z else {}
+        # v1 files predate the recovery counters: zero-backfill so a
+        # pre-failover checkpoint resumes exactly like a fresh v2 one
+        rec = dict(extra.get("recovery") or {})
+        for name in RECOVERY_COUNTERS:
+            rec.setdefault(name, 0)
+        extra["recovery"] = rec
         leaves = []
         for name in SimState._fields:
             key = f"f_{name}"

@@ -69,6 +69,11 @@ Steps, in value order:
                      admission plus the 4-weighted-tenant deadline
                      mix (per-tenant p50/p99 latency, tenant_share,
                      deadline hit rate)
+  failover512        ISSUE-16 fault-tolerance supervisor at a served
+                     512-resident shape (bench.py --failover):
+                     recovery overhead per failure kind (kill/hang/
+                     poison), byte-identity vs the unfailed dumps,
+                     wire-sever client blackout
   elision512         ISSUE-12 event-driven cycle elision at the
                      shipped batch shape (32768 lanes, zipf 8x
                      private hot sets) on the batched XLA engine:
@@ -801,6 +806,26 @@ def main() -> int:
         finally:
             os.environ.pop("HPA2_SERVE_RESIDENT", None)
             os.environ.pop("HPA2_SERVE_POLICY", None)
+
+    if "failover512" not in skip and gate("failover512"):
+        # ISSUE-16: the fault-tolerance supervisor at a served 512
+        # resident shape — recovery overhead per failure kind (kill /
+        # hang / poison at the same interval barrier), the byte-
+        # identity check against the unfailed dumps, and the wire-
+        # sever client blackout.  512 (not 32768): recovery replays
+        # in-flight jobs, so the step measures migration latency, not
+        # peak capacity — the kill row's overhead includes the
+        # migration target's first jit compile.
+        os.environ["HPA2_SERVE_RESIDENT"] = "512"
+        os.environ["HPA2_FAILOVER_AT"] = "3"
+        try:
+            note(run_py(
+                "failover512",
+                [os.path.join(REPO, "bench.py"), "--failover"],
+                timeout_s=3600, argv=True))
+        finally:
+            os.environ.pop("HPA2_SERVE_RESIDENT", None)
+            os.environ.pop("HPA2_FAILOVER_AT", None)
 
     if "elision512" not in skip and gate("elision512"):
         # ISSUE-12: event-driven cycle elision at the shipped batch

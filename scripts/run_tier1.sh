@@ -293,6 +293,69 @@ then
   echo "TIER1: protocol smoke failed" >&2
   exit 1
 fi
+# Chaos smoke (~60s, CPU): the ISSUE-16 fault-tolerance supervisor —
+# a seeded kill on the served pallas path must recover by checkpointed
+# migration onto the jax backend with dumps byte-identical to an
+# unfailed run (migrations >= 1), and a shed-threshold wire server
+# must NACK batch-class overload with the shed accounted in the stats.
+# Catches injector/recovery/schedule-preservation wiring breaks.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python - > /dev/null <<'EOF'
+import tempfile
+import threading
+
+from hpa2_tpu.config import FailurePlan, Semantics, SystemConfig
+from hpa2_tpu.service import WireClient, WireJobSource, WireNack
+from hpa2_tpu.serving import (
+    ListJobSource, job_to_record, serve, supervised_serve,
+    synthetic_jobs)
+
+cfg = SystemConfig(num_procs=4, semantics=Semantics().robust())
+jobs = synthetic_jobs(cfg, 8, 24, seed=7, spread=3.0)
+dump_map = lambda rs: {r.job_id: [repr(d) for d in r.dumps] for r in rs}
+
+base, _ = serve(cfg, ListJobSource(jobs), backend="pallas",
+                resident=4, window=16)
+want = dump_map(base)
+with tempfile.TemporaryDirectory() as td:
+    res, st = supervised_serve(
+        cfg, ListJobSource(jobs), plan=FailurePlan.parse("kill@3", seed=1),
+        checkpoint_dir=td, backend="pallas", resident=4, window=16)
+rec = st.occupancy["recovery"]
+assert dump_map(res) == want, "post-recovery dumps differ from unfailed run"
+assert rec["migrations"] >= 1, rec
+assert rec["failures_detected"] == 1, rec
+
+# graceful degradation: 1-slot queue, batch-class jobs shed loudly
+recs = [job_to_record(j) for j in jobs]
+for i, r in enumerate(recs):
+    if i % 2:
+        r["class"] = "batch"
+    else:
+        r["deadline"] = 8
+src = WireJobSource(cfg, shed_threshold=1)
+shed = []
+def client():
+    with WireClient(*src.address) as cli:
+        for r in recs:
+            try:
+                cli.submit(r)
+            except WireNack as e:
+                assert e.shed, e
+                shed.append(r["id"])
+        cli.finish()
+t = threading.Thread(target=client, daemon=True)
+t.start()
+_, st2 = serve(cfg, src, backend="pallas", resident=4, window=16,
+               emit=src.deliver)
+t.join(timeout=60)
+assert shed, "shed_threshold=1 never shed a batch-class job"
+assert st2.occupancy.get("shed_jobs") == len(shed), (
+    st2.occupancy.get("shed_jobs"), len(shed))
+EOF
+then
+  echo "TIER1: chaos smoke failed" >&2
+  exit 1
+fi
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log

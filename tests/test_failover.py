@@ -1,0 +1,422 @@
+"""Fault-tolerant serving (ISSUE 16): shard-failure injection,
+checkpointed live migration, and wire retry/timeout/backoff.
+
+The contract under test (README "Fault tolerance"):
+
+1. **Recovery is invisible in the output** — a seeded kill / hang /
+   poison mid-run must finish with final dumps byte-identical to an
+   unfailed run of the same feed, whatever backend or shard count the
+   supervisor migrates onto (the primary's window schedule is carried
+   across the migration, so the replay is the *same* legal schedule).
+2. **The failure plan is pure data** — parse/spec round-trip, seeded
+   backoff jitter is a pure function of (seed, attempt), and the
+   injector fires each event exactly once at its interval barrier.
+3. **The wire survives its transport** — a dead server raises
+   :class:`ConnectionLost` instead of hanging, a mid-frame sever is
+   ridden out by reconnect + session resume, and the resent SUBMIT
+   draws the *original* ACK seq flagged ``dup`` (idempotence).
+4. **Degradation is loud and accounted** — past ``shed_threshold``,
+   batch-class jobs draw a structured shed-NACK and the count surfaces
+   in the occupancy stats; checkpoint metadata (schema v2) carries the
+   recovery counters, zero-backfilled when loading v1 files.
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from hpa2_tpu.config import (
+    FailureEvent,
+    FailurePlan,
+    Semantics,
+    SystemConfig,
+)
+from hpa2_tpu.service import (
+    AdmissionLedger,
+    AdmissionReject,
+    AdmissionShed,
+    ConnectionLost,
+    FailureInjector,
+    WireClient,
+    WireJobSource,
+    WireNack,
+    backoff_delay,
+)
+from hpa2_tpu.serving import (
+    ListJobSource,
+    job_to_record,
+    serve,
+    supervised_serve,
+    synthetic_jobs,
+)
+
+ROBUST = Semantics().robust()
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return SystemConfig(num_procs=4, semantics=ROBUST)
+
+
+@pytest.fixture(scope="module")
+def jobs(cfg):
+    return synthetic_jobs(cfg, 8, 24, seed=7, spread=3.0)
+
+
+def _require_devices(n):
+    import jax
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+
+
+def _dump_map(results):
+    return {r.job_id: tuple(repr(d) for d in r.dumps) for r in results}
+
+
+def _recovery(stats):
+    return stats.occupancy.get("recovery", {})
+
+
+# -- the failure plan is pure data ------------------------------------------
+
+
+def test_failure_plan_parse_spec_round_trip():
+    plan = FailurePlan.parse("kill@3; hang@5:1 ;poison@2:7", seed=4)
+    assert [e.kind for e in plan.events] == ["kill", "hang", "poison"]
+    assert [(e.at, e.target) for e in plan.events] == [
+        (3, 0), (5, 1), (2, 7)]
+    assert plan.seed == 4
+    assert plan.enabled
+    assert FailurePlan.parse(plan.spec(), seed=4) == plan
+    assert plan.of_kind("hang") == (FailureEvent("hang", 5, 1),)
+    assert not FailurePlan.parse("").enabled
+
+
+def test_failure_plan_rejects_bad_events():
+    with pytest.raises(ValueError, match="unknown failure kind"):
+        FailurePlan.parse("frob@1")
+    with pytest.raises(ValueError, match="kind@at"):
+        FailurePlan.parse("kill")
+    with pytest.raises(ValueError):
+        FailurePlan.parse("kill@x")
+    with pytest.raises(ValueError, match=">= 0"):
+        FailureEvent("kill", -1)
+
+
+def test_backoff_is_seeded_capped_and_deterministic():
+    a = [backoff_delay(i, base_s=0.05, cap_s=2.0, seed=9)
+         for i in range(12)]
+    assert a == [backoff_delay(i, base_s=0.05, cap_s=2.0, seed=9)
+                 for i in range(12)]
+    # jitter keeps every delay inside [envelope/2, envelope]
+    for i, d in enumerate(a):
+        env = min(2.0, 0.05 * 2.0 ** i)
+        assert env / 2 <= d <= env
+    assert all(d <= 2.0 for d in a)
+    b = [backoff_delay(i, base_s=0.05, cap_s=2.0, seed=10)
+         for i in range(12)]
+    assert a != b  # the seed really feeds the jitter
+
+
+def test_injector_fires_each_event_once():
+    plan = FailurePlan.parse("kill@2")
+    inj = FailureInjector(plan)
+    inj.hook(0, None)
+    inj.hook(1, None)
+    from hpa2_tpu.service import InjectedFailure
+
+    with pytest.raises(InjectedFailure) as ei:
+        inj.hook(2, None)
+    assert ei.value.event.kind == "kill"
+    assert ei.value.interval == 2
+    # once fired, the event never re-fires (the recovered run passes
+    # the same barrier index again)
+    inj.hook(2, None)
+    inj.hook(3, None)
+    assert not inj.pending
+
+
+# -- checkpointed recovery: byte-identical dumps ----------------------------
+
+_SWEEP = [
+    pytest.param("jax", dict(max_trace_len=64, interval=8), id="jax"),
+    pytest.param("pallas", dict(window=8, block=4), id="pallas"),
+    pytest.param(
+        "pallas-sharded",
+        dict(window=8, block=4, data_shards=2),
+        marks=pytest.mark.virtual_mesh, id="data_shards2"),
+    pytest.param(
+        "pallas-node-sharded",
+        dict(window=8, block=4, node_shards=2),
+        marks=pytest.mark.virtual_mesh, id="node_shards2"),
+]
+
+
+@pytest.mark.parametrize("backend,kw", _SWEEP)
+def test_kill_recovers_byte_identical(cfg, jobs, tmp_path, backend, kw):
+    """Kill the backend at interval barrier 3: the supervisor migrates
+    the in-flight jobs onto the default target rotation and the final
+    dumps match the unfailed run byte for byte."""
+    if kw.get("data_shards", 1) > 1 or kw.get("node_shards", 1) > 1:
+        _require_devices(2)
+    base, _ = serve(cfg, ListJobSource(jobs), backend=backend,
+                    resident=4, **kw)
+    want = _dump_map(base)
+    res, stats = supervised_serve(
+        cfg, ListJobSource(jobs), plan=FailurePlan.parse("kill@3", seed=1),
+        checkpoint_dir=str(tmp_path), backend=backend, resident=4, **kw)
+    rec = _recovery(stats)
+    assert _dump_map(res) == want
+    assert rec["failures_detected"] == 1
+    assert rec["migrations"] >= 1
+    assert rec["evacuations"] >= 1
+    assert rec["checkpoints"] >= 1
+    assert stats.jobs_completed == len(jobs)
+
+
+def test_jax_kill_resumes_lanes_mid_state(cfg, jobs, tmp_path):
+    """jax -> jax migration goes through the schema-v2 npz checkpoint:
+    live rows re-admit mid-state (not replayed from instruction 0) and
+    still finish byte-identical to the unfailed run."""
+    kw = dict(backend="jax", resident=4, max_trace_len=64, interval=8)
+    base, _ = serve(cfg, ListJobSource(jobs), **kw)
+    res, stats = supervised_serve(
+        cfg, ListJobSource(jobs), plan=FailurePlan.parse("kill@4", seed=2),
+        targets=[{"backend": "jax", "data_shards": 1}],
+        checkpoint_dir=str(tmp_path), **kw)
+    rec = _recovery(stats)
+    assert _dump_map(res) == _dump_map(base)
+    assert rec["lanes_resumed"] >= 1
+    # the resumed lanes were evacuations that did NOT replay
+    assert rec["evacuations"] >= rec["lanes_resumed"]
+    ck = sorted(p.name for p in tmp_path.iterdir())
+    assert any(n.endswith(".npz") for n in ck), ck
+
+
+def test_hang_watchdog_detects_and_recovers(cfg, jobs, tmp_path):
+    """A hung shard doesn't fail fast — the injector holds the barrier
+    hostage until the watchdog's detect_after budget expires, then the
+    supervisor treats it exactly like a kill (with a diagnostic)."""
+    res, stats = supervised_serve(
+        cfg, ListJobSource(jobs), plan=FailurePlan.parse("hang@2", seed=5),
+        checkpoint_dir=str(tmp_path), detect_after=2,
+        backend="pallas", resident=4, window=8, block=4)
+    rec = _recovery(stats)
+    assert rec["failures_detected"] == 1
+    assert rec["migrations"] >= 1
+    detected = [e for e in rec["events"]
+                if e["event"] == "failure_detected"]
+    assert detected[0]["kind"] == "hang"
+    assert detected[0]["via"] == "watchdog"
+    base, _ = serve(cfg, ListJobSource(jobs), backend="pallas",
+                    resident=4, window=8, block=4)
+    assert _dump_map(res) == _dump_map(base)
+
+
+def test_poison_restarts_same_spec(cfg, jobs, tmp_path):
+    """Poison is corruption, not loss of the backend: the supervisor
+    re-runs the in-flight jobs on a fresh session of the *same* spec —
+    an evacuation but no migration."""
+    res, stats = supervised_serve(
+        cfg, ListJobSource(jobs),
+        plan=FailurePlan.parse("poison@2:1", seed=6),
+        checkpoint_dir=str(tmp_path),
+        backend="pallas", resident=4, window=8, block=4)
+    rec = _recovery(stats)
+    assert rec["failures_detected"] == 1
+    assert rec["migrations"] == 0
+    assert rec["evacuations"] >= 1
+    base, _ = serve(cfg, ListJobSource(jobs), backend="pallas",
+                    resident=4, window=8, block=4)
+    assert _dump_map(res) == _dump_map(base)
+
+
+def test_unfailed_supervised_run_adds_no_recovery_noise(cfg, jobs):
+    """No plan, no checkpoint dir: the supervisor is a pass-through —
+    same dumps, and no 'recovery' key polluting the stats."""
+    base, _ = serve(cfg, ListJobSource(jobs), backend="pallas",
+                    resident=4, window=8, block=4)
+    res, stats = supervised_serve(
+        cfg, ListJobSource(jobs), backend="pallas", resident=4,
+        window=8, block=4)
+    assert _dump_map(res) == _dump_map(base)
+    assert "recovery" not in stats.occupancy
+
+
+# -- the wire layer ---------------------------------------------------------
+
+
+def _records(jobs):
+    return [job_to_record(j) for j in jobs]
+
+
+def test_dead_server_raises_connection_lost_not_hang():
+    """A server that accepts but never speaks: every socket op carries
+    the timeout, so the client surfaces ConnectionLost (after its
+    retry budget) instead of blocking forever."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    try:
+        with pytest.raises(ConnectionLost):
+            WireClient(*srv.getsockname(), timeout_s=0.2, retries=1,
+                       backoff_s=0.01)
+    finally:
+        srv.close()
+
+
+def test_sever_mid_frame_resumes_with_idempotent_submit(cfg, jobs):
+    """The server tears the connection mid-ACK at admission seq 2
+    (a torn frame, then a hard close).  The client reconnects, resumes
+    its session, resends — and gets the ORIGINAL seq back flagged
+    ``dup``, so the admission transcript has no hole and every result
+    still arrives exactly once."""
+    recs = _records(jobs)
+    src = WireJobSource(cfg, failures=FailurePlan.parse("sever@2", seed=3))
+    acks, streamed, state = [], [], {}
+
+    def client():
+        cli = WireClient(*src.address, timeout_s=10.0, retries=4,
+                         backoff_s=0.01, backoff_seed=3)
+        for r in recs:
+            acks.append(cli.submit(r))
+        streamed.extend(cli.finish())
+        state["retries"] = cli.retries
+        state["session"] = cli.session
+        cli.close()
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    results, _ = serve(cfg, src, backend="pallas", resident=4,
+                       window=8, block=4, emit=src.deliver)
+    t.join(timeout=60)
+    assert "retries" in state, "client thread died"
+    assert state["retries"] == 1
+    # the admission transcript is gap-free and the severed submit's
+    # replayed ack carries its original seq
+    assert [a["seq"] for a in acks] == list(range(len(recs)))
+    assert acks[2].get("dup") is True
+    assert not any(a.get("dup") for a in acks[:2] + acks[3:])
+    assert sorted(r["id"] for r in streamed) == sorted(
+        r["id"] for r in recs)
+    assert sorted(r.job_id for r in results) == sorted(
+        r["id"] for r in recs)
+
+
+def test_heartbeats_reach_an_idle_connection(cfg):
+    """heartbeat_s > 0: the server beacons idle connections, so a
+    client can tell a slow backend from a dead one."""
+    import time
+
+    src = WireJobSource(cfg, heartbeat_s=0.01)
+    try:
+        cli = WireClient(*src.address, timeout_s=5.0)
+        time.sleep(0.2)  # let beacons queue on the socket
+        cli.finish()     # absorbs frames until BYE
+        assert cli.heartbeats >= 1
+        cli.close()
+    finally:
+        src.close()
+
+
+def test_shed_threshold_sheds_batch_class_only(cfg, jobs):
+    """Graceful degradation at the ledger: past the pending threshold
+    a batch-class submit draws AdmissionShed (a structured NACK on the
+    wire) while deadline traffic keeps being admitted — and the sheds
+    are counted."""
+    recs = _records(jobs)
+    led = AdmissionLedger(credits=16, shed_threshold=2)
+    assert led.register("c") == 16
+    led.try_submit("c", dict(recs[0], deadline=8))
+    led.try_submit("c", dict(recs[1], **{"class": "batch"}))
+    with pytest.raises(AdmissionShed, match="shedding batch-class"):
+        led.try_submit("c", dict(recs[2], **{"class": "batch"}))
+    # AdmissionShed is an AdmissionReject: wire NACK machinery applies
+    assert issubclass(AdmissionShed, AdmissionReject)
+    # interactive traffic still flows past the threshold
+    seq, _ = led.try_submit("c", dict(recs[3], deadline=8))
+    assert seq == 2
+    assert led.shed_jobs == 1
+
+
+def test_wire_shed_nack_is_structured_and_counted(cfg, jobs):
+    """End to end over the wire: shed NACKs carry ``shed: true`` (the
+    client can tell 'resubmit later' from 'malformed') and the serving
+    stats account every shed job."""
+    recs = _records(jobs)
+    for i, r in enumerate(recs):
+        if i % 2:
+            r["class"] = "batch"
+        else:
+            r["deadline"] = 8
+    src = WireJobSource(cfg, shed_threshold=1)
+    shed = []
+
+    def client():
+        with WireClient(*src.address) as cli:
+            for r in recs:
+                try:
+                    cli.submit(r)
+                except WireNack as e:
+                    assert e.shed, e.payload
+                    shed.append(r["id"])
+            cli.finish()
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    results, stats = serve(cfg, src, backend="pallas", resident=4,
+                           window=8, block=4, emit=src.deliver)
+    t.join(timeout=60)
+    assert shed, "nothing shed at threshold 1"
+    assert stats.occupancy.get("shed_jobs") == len(shed)
+    served = {r.job_id for r in results}
+    assert served.isdisjoint(shed)
+    assert served | set(shed) == {r["id"] for r in recs}
+
+
+# -- checkpoint schema v2 ---------------------------------------------------
+
+
+def test_checkpoint_v2_carries_and_backfills_recovery_counters(tmp_path):
+    import json
+
+    from hpa2_tpu.ops.state import init_state_batched
+    from hpa2_tpu.utils.checkpoint import (
+        RECOVERY_COUNTERS, load_state, save_state)
+    from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+
+    cfg = SystemConfig(num_procs=4, semantics=ROBUST)
+    st = init_state_batched(
+        cfg, *gen_uniform_random_arrays(cfg, 2, 16, seed=0))
+
+    # v2 write: counters travel (zero-defaulted for missing names)
+    p2 = str(tmp_path / "v2.npz")
+    save_state(p2, st, cfg, extra_meta={"recovery": {"migrations": 3}})
+    _, _, meta = load_state(p2, with_meta=True)
+    assert meta["recovery"]["migrations"] == 3
+    for name in RECOVERY_COUNTERS:
+        assert name in meta["recovery"]
+
+    # a v1 file (no meta_version, no recovery) loads with the counters
+    # zero-backfilled instead of KeyErroring the supervisor
+    with np.load(p2) as z:
+        arrays = {k: z[k] for k in z.files if k != "meta_version"}
+    extra = json.loads(str(arrays["meta_extra"]))
+    extra.pop("recovery", None)
+    arrays["meta_extra"] = np.array(json.dumps(extra))
+    p1 = str(tmp_path / "v1.npz")
+    np.savez(p1, **arrays)
+    _, _, meta = load_state(p1, with_meta=True)
+    assert meta["recovery"] == {n: 0 for n in RECOVERY_COUNTERS}
+
+    # a newer-schema file refuses loudly
+    with np.load(p2) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["meta_version"] = np.array(99)
+    p9 = str(tmp_path / "v99.npz")
+    np.savez(p9, **arrays)
+    with pytest.raises(ValueError, match="newer"):
+        load_state(p9)
