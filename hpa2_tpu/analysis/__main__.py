@@ -3,9 +3,10 @@
 Subcommands::
 
     python -m hpa2_tpu.analysis check          # static checks + spec equiv
-    python -m hpa2_tpu.analysis lint           # JAX-pitfall / dead-handler lint
+    python -m hpa2_tpu.analysis lint           # 8-rule AST lint
     python -m hpa2_tpu.analysis equiv          # cross-backend table diff
     python -m hpa2_tpu.analysis mutation-test  # analyzer self-test
+    python -m hpa2_tpu.analysis contracts      # compiled-program contracts
     python -m hpa2_tpu.analysis vmem           # static VMEM budget model
     python -m hpa2_tpu.analysis occupancy      # occupancy scheduler model
     python -m hpa2_tpu.analysis elision        # cycle-elision exact replay
@@ -123,6 +124,65 @@ def cmd_equiv(args: argparse.Namespace) -> int:
                     print(f"  {d}")
                 total += len(diffs)
     return 1 if total else 0
+
+
+def cmd_contracts(args: argparse.Namespace) -> int:
+    # the sharded contract points need a device mesh; re-exec onto the
+    # 8-device virtual CPU mesh (no-op when a device-count flag is
+    # already set, e.g. under run_tier1.sh or after the re-exec).
+    # Under ``python -m`` the re-exec re-runs this file by path, which
+    # drops the cwd from sys.path — pin the package root first.
+    from hpa2_tpu import hostenv
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    pp = os.environ.get("PYTHONPATH", "")
+    if pkg_root not in pp.split(os.pathsep):
+        os.environ["PYTHONPATH"] = (
+            pkg_root + (os.pathsep + pp if pp else ""))
+    hostenv.reexec_with_virtual_mesh(8)
+    from hpa2_tpu.analysis import contracts as contracts_mod
+
+    if args.list:
+        for c in contracts_mod.registry():
+            pinned = sum(1 for r in c.rules if r.expect is None)
+            print(f"{c.name:28s} [{c.engine:7s}] {len(c.rules)} rules "
+                  f"({pinned} pinned, needs {c.needs_devices} "
+                  f"device(s)) — {c.title}")
+        return 0
+    if args.repin:
+        # refuse on a dirty tree outside contracts/ so a repin diff
+        # reviews as ONLY the pin churn, never mixed with source edits
+        import subprocess
+
+        proc = subprocess.run(
+            ["git", "-C", args.root, "status", "--porcelain"],
+            capture_output=True, text=True)
+        if proc.returncode == 0:
+            pin_dir = "hpa2_tpu/analysis/contracts/"
+            dirty = []
+            for line in proc.stdout.splitlines():
+                path = line[3:].split(" -> ")[-1].strip().strip('"')
+                if path and not path.startswith(pin_dir):
+                    dirty.append(path)
+            if dirty:
+                print("--repin refused: working tree dirty outside "
+                      f"{pin_dir}:", file=sys.stderr)
+                for path in dirty[:10]:
+                    print(f"  {path}", file=sys.stderr)
+                print("commit or stash source changes first, so the "
+                      "pin refresh lands as its own reviewable diff",
+                      file=sys.stderr)
+                return 2
+    results = contracts_mod.run_contracts(
+        engine=args.engine, repin=args.repin)
+    drifted = [r for r in results if r.status == "drift"]
+    checked = sum(1 for r in results if r.status == "ok")
+    skipped = sum(1 for r in results if r.status == "skip")
+    print(f"{checked} contract(s) "
+          f"{'repinned' if args.repin else 'clean'}, "
+          f"{len(drifted)} drifted, {skipped} skipped")
+    return 1 if drifted else 0
 
 
 def cmd_vmem(args: argparse.Namespace) -> int:
@@ -266,8 +326,28 @@ def main(argv=None) -> int:
     p.add_argument("-v", "--verbose", action="store_true")
     sub = p.add_subparsers(dest="cmd", required=True)
     sub.add_parser("check", help="static checks + spec equivalence")
-    lp = sub.add_parser("lint", help="JAX-pitfall / dead-handler lint")
+    lp = sub.add_parser(
+        "lint",
+        help="AST lint: traced-branch, nondeterminism, dtype-drift, "
+             "dtype-widening, dead-handler, interconnect-purity, "
+             "hand-written-state, counter-backfill")
     lp.add_argument("--root", default=repo_root)
+    cp = sub.add_parser(
+        "contracts",
+        help="compiled-program contracts: declarative jaxpr/HLO pins "
+             "per engine x config point, with structural drift diffs")
+    cp.add_argument("--check", action="store_true",
+                    help="verify every contract point (the default)")
+    cp.add_argument("--repin", action="store_true",
+                    help="refresh hpa2_tpu/analysis/contracts/*.json "
+                         "from the current lowerings (refuses on a "
+                         "dirty tree outside contracts/)")
+    cp.add_argument("--list", action="store_true",
+                    help="list registered contract points")
+    cp.add_argument("--engine", default=None,
+                    help="restrict to one engine tag (xla, pallas, "
+                         "serving, sharded) or contract name")
+    cp.add_argument("--root", default=repo_root)
     ep = sub.add_parser("equiv", help="cross-backend table diff")
     ep.add_argument("--backends", default="spec,jax,native,pallas",
                     help="comma-separated: spec,jax,native,pallas")
@@ -365,6 +445,7 @@ def main(argv=None) -> int:
         "lint": cmd_lint,
         "equiv": cmd_equiv,
         "mutation-test": cmd_mutation_test,
+        "contracts": cmd_contracts,
         "table": cmd_table,
         "vmem": cmd_vmem,
         "occupancy": cmd_occupancy,

@@ -1,0 +1,830 @@
+"""Compiled-program contracts: declarative pins on lowered artifacts.
+
+Every performance claim the repo makes — O(1)-depth exchange
+collectives, the elided hot loop's "+1 reduce_min +1 cond" structure,
+packed-plane dtypes, zero-recompile serving — is a property of the
+*lowered program* (jaxpr or compiled HLO), not the source.  A
+:class:`Contract` names one engine × config point, a ``measure``
+function that traces it through :mod:`hpa2_tpu.analysis.ir` (the one
+canonical walker), and a list of :class:`Rule` bounds over the
+measured values.
+
+Two rule flavors:
+
+* **invariant** (``expect`` is an int): a hard structural law —
+  ``gather == 0``, ``psum == 2``, the 2172/2194 cycle-op ceilings.
+  Never repinned; weakening one is a deliberate source edit.
+* **pinned** (``expect`` is None): the expected value lives in
+  ``hpa2_tpu/analysis/contracts/<name>.json``, digest-keyed against
+  the rule spec like the protocol planes.  ``--repin`` refreshes the
+  files so a benign lowering change lands as a reviewable JSON diff.
+
+On violation the checker emits a structural **drift diff** — contract,
+key, expected vs found, a path into the jaxpr to the offending
+primitive, and the rule's rationale — instead of a bare assert.
+
+CLI: ``python -m hpa2_tpu.analysis contracts [--check|--repin|--list]
+[--engine TAG]``.  Points needing a device mesh (the sharded engines)
+skip cleanly when the host exposes too few devices; the CLI re-execs
+onto the 8-device virtual CPU mesh so they normally all run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from hpa2_tpu.analysis import ir
+
+CONTRACT_DIR = os.path.join(os.path.dirname(__file__), "contracts")
+
+_OPS = {
+    "==": lambda got, want: got == want,
+    "<=": lambda got, want: got <= want,
+    ">=": lambda got, want: got >= want,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One bound over a measured value.  ``expect is None`` marks a
+    pinned rule whose expected value lives in the contract's JSON."""
+
+    key: str
+    op: str
+    expect: Optional[int]
+    why: str
+
+
+@dataclasses.dataclass
+class Observation:
+    """What a measure function saw: the value census plus, for keys
+    whose violation has a location, a path into the jaxpr."""
+
+    values: Dict[str, int]
+    where: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class Contract:
+    name: str
+    engine: str  # grouping tag: xla | pallas | serving | sharded
+    title: str
+    measure: Callable[[], Observation]
+    rules: Tuple[Rule, ...]
+    needs_devices: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Drift:
+    """One line of a structural drift diff."""
+
+    contract: str
+    key: str
+    op: str
+    expected: object
+    found: object
+    where: str = ""
+    why: str = ""
+
+    def render(self) -> str:
+        lines = [f"  {self.key}: expected {self.op} {self.expected}, "
+                 f"found {self.found}"]
+        if self.where:
+            lines.append(f"    at: {self.where}")
+        if self.why:
+            lines.append(f"    why: {self.why}")
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class CheckResult:
+    contract: str
+    engine: str
+    status: str  # ok | drift | skip
+    drifts: List[Drift] = dataclasses.field(default_factory=list)
+    note: str = ""
+
+
+# -- pin files --------------------------------------------------------
+
+
+def spec_digest(c: Contract) -> str:
+    """Digest of the rule spec — a pin file minted for an older rule
+    set is stale and must be regenerated, exactly like the compiled
+    protocol planes' digest pin."""
+    blob = json.dumps(
+        {"contract": c.name, "engine": c.engine,
+         "rules": [[r.key, r.op, r.expect] for r in c.rules]},
+        sort_keys=True, separators=(",", ":"),
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _pin_path(name: str) -> str:
+    return os.path.join(CONTRACT_DIR, f"{name}.json")
+
+
+def load_pins(c: Contract) -> Optional[dict]:
+    try:
+        with open(_pin_path(c.name)) as fh:
+            return json.load(fh)
+    except FileNotFoundError:
+        return None
+
+
+def write_pins(c: Contract, obs: Observation) -> str:
+    os.makedirs(CONTRACT_DIR, exist_ok=True)
+    doc = {
+        "contract": c.name,
+        "engine": c.engine,
+        "digest": spec_digest(c),
+        "pins": {r.key: int(obs.values[r.key])
+                 for r in c.rules if r.expect is None},
+        # informational: everything measured at repin time, so a
+        # contract diff reviews as "what moved", not just "what broke"
+        "observed": {k: int(v) for k, v in sorted(obs.values.items())},
+    }
+    path = _pin_path(c.name)
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+# -- checking ---------------------------------------------------------
+
+
+def check_contract(c: Contract, obs: Observation) -> List[Drift]:
+    drifts: List[Drift] = []
+    pins = None
+    if any(r.expect is None for r in c.rules):
+        doc = load_pins(c)
+        if doc is None:
+            return [Drift(c.name, "<pin-file>", "==", "present",
+                          "missing",
+                          why="no pinned expectations on disk — run "
+                              "`analysis contracts --repin`")]
+        if doc.get("digest") != spec_digest(c):
+            return [Drift(c.name, "<pin-file>", "==", spec_digest(c)[:12],
+                          str(doc.get("digest"))[:12],
+                          why="rule spec changed since the pin file was "
+                              "minted — run `analysis contracts --repin`")]
+        pins = doc.get("pins", {})
+    for r in c.rules:
+        expected = r.expect
+        if expected is None:
+            if r.key not in pins:
+                drifts.append(Drift(c.name, r.key, r.op, "<pinned>",
+                                    "missing pin",
+                                    why="run `analysis contracts --repin`"))
+                continue
+            expected = pins[r.key]
+        found = obs.values.get(r.key)
+        if found is None:
+            drifts.append(Drift(c.name, r.key, r.op, expected,
+                                "not measured", why=r.why))
+        elif not _OPS[r.op](found, expected):
+            drifts.append(Drift(c.name, r.key, r.op, expected, found,
+                                where=obs.where.get(r.key, ""),
+                                why=r.why))
+    return drifts
+
+
+def run_contracts(engine: Optional[str] = None,
+                  names: Optional[Sequence[str]] = None,
+                  repin: bool = False,
+                  out: Callable[[str], None] = print
+                  ) -> List[CheckResult]:
+    """Measure every registered contract point (optionally filtered by
+    engine tag or name) and check — or, with ``repin``, refresh — its
+    pins.  Prints drift diffs through ``out``; returns per-contract
+    results."""
+    import jax
+
+    ndev = len(jax.devices())
+    results: List[CheckResult] = []
+    for c in registry():
+        if engine and c.engine != engine and c.name != engine:
+            continue
+        if names and c.name not in names:
+            continue
+        if ndev < c.needs_devices:
+            results.append(CheckResult(
+                c.name, c.engine, "skip",
+                note=f"needs {c.needs_devices} devices, have {ndev}"))
+            out(f"SKIP  {c.name} [{c.engine}] — needs "
+                f"{c.needs_devices} devices, have {ndev}")
+            continue
+        obs = c.measure()
+        if repin:
+            path = write_pins(c, obs)
+            results.append(CheckResult(c.name, c.engine, "ok",
+                                       note=f"pinned -> {path}"))
+            out(f"PIN   {c.name} [{c.engine}] -> {os.path.relpath(path)}")
+            continue
+        drifts = check_contract(c, obs)
+        if drifts:
+            results.append(CheckResult(c.name, c.engine, "drift", drifts))
+            out(f"DRIFT {c.name} [{c.engine}] — {c.title}")
+            for d in drifts:
+                out(d.render())
+        else:
+            results.append(CheckResult(c.name, c.engine, "ok"))
+            out(f"OK    {c.name} [{c.engine}]")
+    return results
+
+
+# -- measure functions ------------------------------------------------
+#
+# Each traces one engine × config point and reduces the lowered
+# program to a flat {key: int} census through analysis/ir.py.  Module
+# attributes (step.build_run, exchange.make_plan, ...) are resolved at
+# call time so the seeded-mutation harness can intercept them.
+
+
+def _base_cfg(n=4, **kw):
+    from hpa2_tpu.config import Semantics, SystemConfig
+
+    return SystemConfig(num_procs=n, semantics=Semantics().robust(),
+                        **kw)
+
+
+def _paths(where: Dict[str, str], key: str, jaxpr,
+           prims: Sequence[str]) -> None:
+    p = ir.prim_paths(jaxpr, prims, limit=4)
+    if p:
+        where[key] = "; ".join(p)
+
+
+def _run_body(cfg):
+    """The outer while body of the XLA run program (the big sub-jaxpr;
+    the other one is the cond)."""
+    import jax
+
+    from hpa2_tpu.ops import state as state_mod
+    from hpa2_tpu.ops import step as step_mod
+    from hpa2_tpu.utils import trace as trace_mod
+
+    traces = trace_mod.gen_hot_hit_zipf(cfg, 8, seed=0)
+    jx = jax.make_jaxpr(step_mod.build_run(cfg))(
+        state_mod.init_state(cfg, traces))
+    body = ir.largest_body(jx.jaxpr, "while")
+    assert body is not None, "run program lost its while_loop"
+    return body
+
+
+_TOP_PRIMS = ("reduce_min", "cond", "while", "scan", "dot_general",
+              "sort")
+
+
+def measure_run_loop(cfg=None) -> Observation:
+    """PR-12 pin: the event-driven loop body adds ONE reduction (the
+    jump min) and ONE cond at its top level; the lockstep escape hatch
+    rebuilds the bigger cond-free body."""
+    import dataclasses as dc
+
+    cfg = cfg or _base_cfg()
+    body = _run_body(cfg)
+    values = {f"elided.{k}": v
+              for k, v in ir.top_counts(body, _TOP_PRIMS).items()}
+    values["elided.eqns"] = len(body.eqns)
+    lockstep = _run_body(dc.replace(cfg, elide=False))
+    values["lockstep.cond"] = ir.top_counts(lockstep, ("cond",))["cond"]
+    values["lockstep.extra_eqns"] = len(lockstep.eqns) - len(body.eqns)
+    where: Dict[str, str] = {}
+    for k in ("while", "scan", "dot_general", "sort"):
+        if values[f"elided.{k}"]:
+            _paths(where, f"elided.{k}", body, (k,))
+    return Observation(values, where)
+
+
+def measure_interconnect_loop() -> Observation:
+    """The PR-11 interconnect JAX step (mesh2d) under elision —
+    previously unguarded: same one-reduction/one-cond shape, no
+    gather-the-world delivery."""
+    from hpa2_tpu.config import InterconnectConfig
+
+    cfg = _base_cfg(interconnect=InterconnectConfig(topology="mesh2d"))
+    body = _run_body(cfg)
+    values = {k: v for k, v in ir.top_counts(body, _TOP_PRIMS).items()}
+    values["eqns"] = len(body.eqns)
+    values["gather"] = ir.count_prims(body, ir.GATHER_PRIMS)
+    where: Dict[str, str] = {}
+    if values["gather"]:
+        _paths(where, "gather", body, ir.GATHER_PRIMS)
+    return Observation(values, where)
+
+
+def measure_cycle_ops() -> Observation:
+    """The per-cycle op budget at the bench shape (PR-6/PR-9 ceilings):
+    streaming and every later feature must not grow the hot loop."""
+    import jax
+    import jax.numpy as jnp
+
+    from hpa2_tpu.ops import pallas_engine as pe
+
+    cfg = _base_cfg(8, msg_buffer_size=16)
+    values: Dict[str, int] = {}
+    where: Dict[str, str] = {}
+    for snapshots, key in ((False, "eqns.plain"), (True, "eqns.snap")):
+        st = {k: jnp.asarray(v)
+              for k, v in pe._init_state(cfg, 8, snapshots).items()}
+        st["tr"] = jnp.zeros((8, 8, 8), jnp.int32)
+        st["tr_len"] = jnp.zeros((8, 8), jnp.int32)
+        jx = jax.make_jaxpr(pe.build_cycle(cfg, 8, snapshots))(st)
+        values[key] = ir.count_eqns(jx.jaxpr)
+        if not snapshots:
+            coll = "collectives"
+            values[coll] = ir.count_prims(jx.jaxpr, ir.COLLECTIVE_PRIMS)
+            if values[coll]:
+                _paths(where, coll, jx.jaxpr, ir.COLLECTIVE_PRIMS)
+    return Observation(values, where)
+
+
+def measure_stream_dma() -> Observation:
+    """Streaming copies live at window boundaries only: the quiescence
+    while loop carries no DMA primitives; the kernel overall streams."""
+    import jax
+
+    from hpa2_tpu.ops import pallas_engine as pe
+    from hpa2_tpu.utils import trace as trace_mod
+
+    cfg = _base_cfg(8, msg_buffer_size=16)
+    arrays = trace_mod.gen_uniform_random_arrays(cfg, 8, 16, seed=1)
+    eng = pe.PallasEngine(cfg, *arrays, interpret=True, stream=True,
+                          snapshots=False, trace_window=8,
+                          gate=False, block=8)
+    jx = jax.make_jaxpr(eng._runner(10_000))(
+        eng.state, eng._tr_full, eng._tr_len_full)
+    kernels = ir.find_subjaxprs(jx.jaxpr, "pallas_call")
+    values = {
+        "kernels": len(kernels),
+        "dma_start.total": sum(
+            ir.count_prims(k, ("dma_start",)) for k in kernels),
+        "dma.in_while": sum(
+            ir.count_prims(wh, ("dma_start", "dma_wait"))
+            for k in kernels for wh in ir.find_subjaxprs(k, "while")),
+    }
+    where: Dict[str, str] = {}
+    if values["dma.in_while"]:
+        for k in kernels:
+            for wh in ir.find_subjaxprs(k, "while"):
+                _paths(where, "dma.in_while", wh,
+                       ("dma_start", "dma_wait"))
+    return Observation(values, where)
+
+
+def measure_packed_planes() -> Observation:
+    """Dtype rule: the packed cycle's carried state leaves as narrow
+    as it entered (u8/u16 planes; widening is transient, inside the
+    `_widen*` helpers), with a bounded number of dtype converts."""
+    import jax
+    import jax.numpy as jnp
+
+    from hpa2_tpu.ops import pallas_engine as pe
+
+    cfg = _base_cfg(4, cache_size=4, mem_size=64, msg_buffer_size=4)
+    st = {k: jnp.asarray(v)
+          for k, v in pe._init_state(cfg, 8, snapshots=False,
+                                     packed=True).items()}
+    st["tr"] = jnp.zeros((4, 8, 8), jnp.int32)
+    st["tr_len"] = jnp.zeros((4, 8), jnp.int32)
+    jx = jax.make_jaxpr(
+        pe.build_cycle(cfg, 8, snapshots=False, packed=True))(st)
+    return Observation({
+        "narrow_outvars": ir.narrow_outvars(jx.jaxpr),
+        "converts": ir.count_prims(jx.jaxpr, ("convert_element_type",)),
+        "eqns": ir.count_eqns(jx.jaxpr),
+    })
+
+
+def measure_vmem_budget() -> Observation:
+    """VMEM byte budgets, delegated to analysis/vmem.py: the bench
+    block widths must fit, packing must save bytes, node sharding must
+    shrink the per-shard footprint."""
+    from hpa2_tpu.analysis.vmem import vmem_budget
+
+    cfg = _base_cfg(8, msg_buffer_size=16)
+    kw = dict(snapshots=False, gate=False, stream=True)
+    b1024 = vmem_budget(cfg, 1024, 32, **kw)
+    b2048 = vmem_budget(cfg, 2048, 32, **kw)
+    pcfg = _base_cfg(4, cache_size=4, mem_size=64, msg_buffer_size=4)
+    plain = vmem_budget(pcfg, 8, 4, snapshots=False)
+    packed = vmem_budget(pcfg, 8, 4, snapshots=False, packed=True)
+    shard = vmem_budget(cfg, 1024, 32, node_shards=2, **kw)
+    return Observation({
+        "fits.block1024": int(b1024.fits),
+        "fits.block2048": int(b2048.fits),
+        "packed.saves_bytes": int(packed.total_bytes < plain.total_bytes),
+        "shard.shrinks": int(shard.total_bytes < b1024.total_bytes),
+    })
+
+
+def measure_serving_session() -> Observation:
+    """The Pallas serving session: a full stage/advance/harvest/
+    barrier round compiles each program exactly once (the
+    zero-recompile pin) and its runner stays a gathered-collective-free
+    single-kernel program."""
+    import jax
+    import numpy as np
+
+    from hpa2_tpu.ops import pallas_engine as pe
+
+    cfg = _base_cfg()
+    r, w = 4, 8
+    sess = pe.PallasLaneSession(cfg, r, w, block=4, cycles_per_call=16,
+                                max_cycles=64)
+    n = cfg.num_procs
+    tr, tl = sess.stage(np.zeros((n, w, r), np.int32),
+                        np.zeros((n, r), np.int32))
+    status = sess.advance(tr, tl)
+    sess.harvest(0)
+    sess.barrier(np.arange(r), np.zeros(r, bool))
+    sess.check(status)
+    counts = sess.compile_counts()
+    jx = jax.make_jaxpr(sess._runner)(sess.state, tr, tl)
+    values = {f"compiles.{k}": v for k, v in counts.items()}
+    values["runner.pallas_call"] = ir.count_prims(
+        jx.jaxpr, ("pallas_call",))
+    values["runner.gather"] = ir.count_prims(jx.jaxpr, ir.GATHER_PRIMS)
+    values["runner.eqns"] = ir.count_eqns(jx.jaxpr)
+    where: Dict[str, str] = {}
+    if values["runner.gather"]:
+        _paths(where, "runner.gather", jx.jaxpr, ir.GATHER_PRIMS)
+    return Observation(values, where)
+
+
+def measure_recovery_resume() -> Observation:
+    """The recovery supervisor's jax→jax mid-state resume program
+    (serving/recovery.py `_resume_rows`): a BatchLaneSession driven
+    admit → advance → take_row → retire, each program compiled once,
+    the chunk runner carrying the elision reduce_min and no gathers."""
+    import jax
+
+    from hpa2_tpu.ops import engine as engine_mod
+    from hpa2_tpu.utils import trace as trace_mod
+
+    cfg = _base_cfg()
+    sess = engine_mod.BatchLaneSession(cfg, 2, 16, interval=32,
+                                       max_cycles=4096)
+    row = sess.fresh_row(trace_mod.gen_uniform_random(cfg, 4, seed=0))
+    sess.admit(0, row)
+    for _ in range(64):
+        sess.advance()
+        if sess.quiescent_rows()[0]:
+            break
+    else:
+        raise AssertionError("resume row never quiesced")
+    sess.take_row(0)
+    sess.retire(0)
+    counts = sess.compile_counts()
+    jx = jax.make_jaxpr(sess._runner)(sess.state)
+    values = {f"compiles.{k}": v for k, v in counts.items()}
+    values["runner.reduce_min"] = ir.count_prims(jx.jaxpr,
+                                                 ("reduce_min",))
+    values["runner.gather"] = ir.count_prims(jx.jaxpr, ir.GATHER_PRIMS)
+    values["runner.while"] = ir.count_prims(jx.jaxpr, ("while",))
+    values["runner.eqns"] = ir.count_eqns(jx.jaxpr)
+    where: Dict[str, str] = {}
+    if values["runner.gather"]:
+        _paths(where, "runner.gather", jx.jaxpr, ir.GATHER_PRIMS)
+    return Observation(values, where)
+
+
+def measure_node_sharded(kind: str, mode: str,
+                         node_shards: int) -> Observation:
+    """Collective census of a node-sharded run program's shard bodies,
+    keyed like `exchange.plan_collectives` — the PR-15 pin.  ``kind``
+    picks the Pallas fast path or the retrofitted ops/step.py path."""
+    import dataclasses as dc
+
+    import jax
+
+    from hpa2_tpu.ops import exchange
+    from hpa2_tpu.parallel import sharding
+    from hpa2_tpu.utils import trace as trace_mod
+
+    cfg = dc.replace(_base_cfg(8), exchange_mode=mode)
+    if kind == "pallas":
+        arrays = trace_mod.gen_uniform_random_arrays(cfg, 4, 12, seed=1)
+        eng = sharding.NodeShardedPallasEngine(
+            cfg, *arrays, node_shards=node_shards, cycles_per_call=16)
+        jx = jax.make_jaxpr(eng._runner(10_000))(
+            eng.state, eng._tr_full, eng._tr_len_full).jaxpr
+    else:
+        traces = trace_mod.gen_uniform_random(cfg, 12, seed=7)
+        eng = sharding.NodeShardedEngine(
+            cfg, traces, mesh=sharding.make_mesh(node_shards=node_shards))
+        jx = jax.make_jaxpr(eng._run)(eng.state).jaxpr
+    bodies = ir.find_subjaxprs(jx, "shard_map")
+    assert bodies, "node-sharded run lost its shard_map"
+    values = dict(ir.collective_counts(bodies))
+    plan = exchange.plan_collectives(
+        exchange.make_plan(node_shards, mode, 0))
+    values["plan.ppermute"] = plan["ppermute"]
+    values["plan.all_to_all"] = plan["all_to_all"]
+    where: Dict[str, str] = {}
+    for key, prims in (("gather", ir.GATHER_PRIMS),
+                       ("ppermute", ("ppermute",)),
+                       ("all_to_all", ("all_to_all",))):
+        if values[key]:
+            for b in bodies:
+                _paths(where, key, b, prims)
+    return Observation(values, where)
+
+
+def measure_data_sharded() -> Observation:
+    """The data-sharded Pallas path: per-shard run program collective-
+    free at the jaxpr layer AND in the compiled-HLO loop closure, with
+    the donation aliases the zero-copy carry depends on."""
+    import jax
+
+    from hpa2_tpu.parallel import sharding
+    from hpa2_tpu.utils import trace as trace_mod
+
+    cfg = _base_cfg()
+    arrays = trace_mod.gen_uniform_random_arrays(cfg, 32, 8, seed=1)
+    eng = sharding.DataShardedPallasEngine(cfg, *arrays, data_shards=8,
+                                           block=4)
+    jx = jax.make_jaxpr(eng._runner(10_000))(
+        eng.state, eng._tr_full, eng._tr_len_full)
+    bodies = ir.find_subjaxprs(jx.jaxpr, "shard_map")
+    assert bodies, "sharded runner lost its shard_map"
+    text = eng.lower_run(10_000).compile().as_text()
+    offenders = ir.hlo_loop_collectives(text)
+    values = {
+        "shard_map": len(bodies),
+        "shard_body.pallas_call": sum(
+            ir.count_prims(b, ("pallas_call",)) for b in bodies),
+        "shard_body.collectives": sum(
+            ir.count_prims(b, ir.COLLECTIVE_PRIMS) for b in bodies),
+        "hlo.loop_collectives": len(offenders),
+        "hlo.aliased_outputs": ir.hlo_aliased_outputs(text),
+    }
+    where: Dict[str, str] = {}
+    if values["shard_body.collectives"]:
+        for b in bodies:
+            _paths(where, "shard_body.collectives", b,
+                   ir.COLLECTIVE_PRIMS)
+    if offenders:
+        where["hlo.loop_collectives"] = "; ".join(
+            f"{name}: {line}" for name, line in offenders[:4])
+    return Observation(values, where)
+
+
+# -- registry ---------------------------------------------------------
+
+
+def registry() -> List[Contract]:
+    """Every contract point, in check order.  Literal expectations are
+    invariants; ``None`` expectations are pinned in contracts/*.json."""
+    elide_why = ("the event-driven loop adds exactly one jump-min "
+                 "reduction and one fast-forward cond at the body top "
+                 "level (PR 12)")
+    coll_why = ("the exchange ships exactly the planned collectives "
+                "(PR 15); gather-the-world delivery is banned")
+    compile_why = "zero-recompile serving: one jit entry per program"
+    return [
+        Contract(
+            "xla-run-loop", "xla",
+            "event-driven XLA run loop structure",
+            measure_run_loop,
+            (
+                Rule("elided.reduce_min", "==", 1, elide_why),
+                Rule("elided.cond", "==", 1, elide_why),
+                Rule("elided.while", "==", 0, elide_why),
+                Rule("elided.scan", "==", 0, elide_why),
+                Rule("elided.dot_general", "==", 0, elide_why),
+                Rule("elided.sort", "==", 0, elide_why),
+                Rule("lockstep.cond", "==", 0,
+                     "the escape hatch rebuilds the pure lockstep body"),
+                Rule("lockstep.extra_eqns", ">=", 1,
+                     "the lockstep body inlines what elision hides "
+                     "behind the cond"),
+                Rule("elided.eqns", "<=", None,
+                     "top-level op budget of the elided body"),
+            ),
+        ),
+        Contract(
+            "xla-run-interconnect", "xla",
+            "interconnect (mesh2d) JAX step under elision",
+            measure_interconnect_loop,
+            (
+                Rule("reduce_min", "==", 1, elide_why),
+                Rule("cond", "==", 1, elide_why),
+                Rule("while", "==", 0, elide_why),
+                Rule("scan", "==", 0, elide_why),
+                Rule("sort", "==", 0, elide_why),
+                Rule("dot_general", "==", 0, elide_why),
+                Rule("gather", "==", 0,
+                     "topology delivery is link-priced, never "
+                     "gather-the-world"),
+                Rule("eqns", "<=", None,
+                     "top-level op budget of the mesh2d body"),
+            ),
+        ),
+        Contract(
+            "pallas-cycle-body", "pallas",
+            "per-cycle op budget at the bench shape",
+            measure_cycle_ops,
+            (
+                Rule("eqns.plain", "<=", 2172,
+                     "the hot loop must not pay for streaming (or "
+                     "anything else) per cycle — historical ceiling"),
+                Rule("eqns.snap", "<=", 2194,
+                     "snapshot variant of the same ceiling"),
+                Rule("collectives", "==", 0,
+                     "the single-system cycle body is collective-free"),
+            ),
+        ),
+        Contract(
+            "pallas-stream-dma", "pallas",
+            "streaming DMA outside the quiescence loop",
+            measure_stream_dma,
+            (
+                Rule("kernels", ">=", 1,
+                     "streaming runner keeps its pallas_call"),
+                Rule("dma_start.total", ">=", 2,
+                     "warm-up + prefetch copies must stream"),
+                Rule("dma.in_while", "==", 0,
+                     "copies live at window boundaries only — never "
+                     "inside the per-cycle quiescence loop"),
+            ),
+        ),
+        Contract(
+            "pallas-packed-planes", "pallas",
+            "packed-plane dtype rule (u8/u16 never escape)",
+            measure_packed_planes,
+            (
+                Rule("narrow_outvars", ">=", 1,
+                     "the packed cycle carries narrow planes at all"),
+                Rule("narrow_outvars", "==", None,
+                     "every narrow plane that enters the cycle leaves "
+                     "it narrow — widening is transient"),
+                Rule("converts", "<=", None,
+                     "dtype converts stay bounded (no accidental "
+                     "widen/narrow churn)"),
+            ),
+        ),
+        Contract(
+            "pallas-vmem-budget", "pallas",
+            "VMEM byte budgets (analysis/vmem.py)",
+            measure_vmem_budget,
+            (
+                Rule("fits.block1024", "==", 1,
+                     "bench block 1024 fits the 16 MiB cap"),
+                Rule("fits.block2048", "==", 1,
+                     "bench block 2048 fits the 16 MiB cap"),
+                Rule("packed.saves_bytes", "==", 1,
+                     "packing must shrink the per-lane footprint"),
+                Rule("shard.shrinks", "==", 1,
+                     "node sharding must shrink the per-shard "
+                     "footprint"),
+            ),
+        ),
+        Contract(
+            "pallas-serving-session", "serving",
+            "Pallas serving session (stage/advance/harvest/barrier)",
+            measure_serving_session,
+            (
+                Rule("compiles.runner", "==", 1, compile_why),
+                Rule("compiles.barrier", "==", 1, compile_why),
+                Rule("compiles.take_lane", "==", 1, compile_why),
+                Rule("runner.pallas_call", ">=", 1,
+                     "session runner keeps its kernel"),
+                Rule("runner.gather", "==", 0, coll_why),
+                Rule("runner.eqns", "<=", None,
+                     "op budget of the session interval program"),
+            ),
+        ),
+        Contract(
+            "serving-recovery-resume", "serving",
+            "recovery supervisor's jax→jax mid-state resume",
+            measure_recovery_resume,
+            (
+                Rule("compiles.runner", "==", 1, compile_why),
+                Rule("compiles.admit", "==", 1, compile_why),
+                Rule("compiles.take_row", "==", 1, compile_why),
+                Rule("compiles.quiescent", "==", 1, compile_why),
+                Rule("runner.while", ">=", 1,
+                     "the chunk program keeps its while loop"),
+                Rule("runner.gather", "==", 0, coll_why),
+                Rule("runner.reduce_min", "==", None,
+                     "the batched chunk carries the elision jump-min "
+                     "per row"),
+                Rule("runner.eqns", "<=", None,
+                     "op budget of the resume chunk program"),
+            ),
+        ),
+        Contract(
+            "data-sharded-pallas", "sharded",
+            "data-sharded per-shard program collective-free",
+            measure_data_sharded,
+            (
+                Rule("shard_map", ">=", 1,
+                     "sharded runner keeps its shard_map"),
+                Rule("shard_body.pallas_call", ">=", 1,
+                     "shard body keeps its kernel"),
+                Rule("shard_body.collectives", "==", 0,
+                     "each shard's whole run is independent; the "
+                     "status reduce lives outside the shard_map"),
+                Rule("hlo.loop_collectives", "==", 0,
+                     "no collective in the compiled while-loop closure "
+                     "(the ENTRY status all-reduce is permitted)"),
+                Rule("hlo.aliased_outputs", ">=", None,
+                     "donation floor: the compiler must keep aliasing "
+                     "the carried state"),
+            ),
+            needs_devices=8,
+        ),
+        Contract(
+            "node-sharded-pallas-a2a", "sharded",
+            "node-sharded Pallas collectives (a2a schedule, D=4)",
+            lambda: measure_node_sharded("pallas", "a2a", 4),
+            (
+                Rule("ppermute", "==", 0, coll_why),
+                Rule("all_to_all", "==", 2, coll_why),
+                Rule("psum", "==", 2,
+                     "one stacked counter/quiescence psum in the cycle "
+                     "+ the per-segment activity seed psum"),
+                Rule("pmax", "==", 3,
+                     "telemetry pmax + whole-mesh loop gate traced in "
+                     "while seed and loop body"),
+                Rule("gather", "==", 0, coll_why),
+            ),
+            needs_devices=4,
+        ),
+        Contract(
+            "node-sharded-jax-a2a", "sharded",
+            "node-sharded ops/step.py collectives (a2a schedule, D=4)",
+            lambda: measure_node_sharded("jax", "a2a", 4),
+            (
+                Rule("ppermute", "==", 0, coll_why),
+                Rule("all_to_all", "==", 2, coll_why),
+                Rule("psum", "==", 2, coll_why),
+                Rule("pmax", "==", 1, coll_why),
+                Rule("gather", "==", 0, coll_why),
+            ),
+            needs_devices=4,
+        ),
+        Contract(
+            "node-sharded-jax-pairwise", "sharded",
+            "node-sharded ops/step.py collectives (pairwise, D=4)",
+            lambda: measure_node_sharded("jax", "pairwise", 4),
+            (
+                Rule("ppermute", "==", 6,
+                     "the pairwise schedule ships 2*(D-1) serial "
+                     "ppermutes at D=4 — the PR-15 baseline shape"),
+                Rule("all_to_all", "==", 0, coll_why),
+                Rule("psum", "==", 2, coll_why),
+                Rule("pmax", "==", 1, coll_why),
+                Rule("gather", "==", 0, coll_why),
+            ),
+            needs_devices=4,
+        ),
+    ]
+
+
+# -- seeded mutation (negative-test harness) --------------------------
+
+
+@contextlib.contextmanager
+def seeded_mutation(seed: int):
+    """Perturb one op module (monkeypatched, restored on exit) so a
+    contract check MUST fail with a drift diff — the negative test
+    that proves the contracts actually bite.
+
+    seed % 2 == 0: force every exchange plan to the serial pairwise
+    schedule (``ops/exchange.make_plan``) — the a2a contracts drift on
+    ``ppermute``/``all_to_all``.
+    seed % 2 == 1: strip elision from the XLA run builder
+    (``ops/step.build_run``) — xla-run-loop drifts on
+    ``reduce_min``/``cond``.
+    """
+    import dataclasses as dc
+
+    from hpa2_tpu.ops import exchange, step
+
+    if seed % 2 == 0:
+        mod, attr = exchange, "make_plan"
+        orig = exchange.make_plan
+
+        def mutant(d, mode="pairwise", inner=0):
+            return orig(d, "pairwise", inner)
+    else:
+        mod, attr = step, "build_run"
+        orig = step.build_run
+
+        def mutant(cfg, *a, **kw):
+            return orig(dc.replace(cfg, elide=False), *a, **kw)
+
+    setattr(mod, attr, mutant)
+    try:
+        yield f"{mod.__name__}.{attr}"
+    finally:
+        setattr(mod, attr, orig)

@@ -1,0 +1,237 @@
+"""Canonical IR traversal for the compiled-program contracts.
+
+One walker to rule out six: before ISSUE-17 every jaxpr pin in the
+repo (tests/test_elision.py, test_node_sharded_pallas.py,
+test_data_sharded_pallas.py, test_vmem_budget.py, test_occupancy.py)
+carried its own copy of the subjaxpr recursion.  This module is now
+the only traversal — everything that inspects a lowered program
+(primitive census, collective census, while/cond closure extraction,
+HLO text probes, jit-cache counts) goes through here, so ROADMAP's
+lowering churn (in-kernel DMA exchange, per-block jumps) changes one
+walker, not six.
+
+Everything is pure inspection: no tracing happens here (callers hand
+in `jax.make_jaxpr(...)` output or compiled-HLO text), so the module
+imports without jax.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, Iterator, List, Sequence, Tuple
+
+# -- jaxpr layer ------------------------------------------------------
+
+#: collective families, keyed the way ``exchange.plan_collectives``
+#: keys its schedule counts.  psum lowers to psum2/psum_invariant on
+#: recent jax; the gather family is the banned "gather-the-world"
+#: delivery relapse.
+PSUM_PRIMS = ("psum", "psum2", "psum_invariant")
+GATHER_PRIMS = ("all_gather", "all_gather_invariant")
+COLLECTIVE_PRIMS = (
+    "psum", "psum2", "pmax", "pmin", "ppermute", "pbroadcast",
+    "all_gather", "all_to_all", "reduce_scatter",
+)
+
+
+def unwrap(jaxpr):
+    """Accept a ClosedJaxpr or a Jaxpr; return the Jaxpr."""
+    return getattr(jaxpr, "jaxpr", jaxpr)
+
+
+def subvalues(eqn) -> Iterator[object]:
+    """Yield the sub-jaxprs carried in an equation's params (pjit /
+    while / cond / scan / shard_map / pallas_call / custom_* all stash
+    them differently: bare Jaxpr, ClosedJaxpr, or lists of either)."""
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (list, tuple)) else (v,)
+        for x in vs:
+            if hasattr(x, "jaxpr"):
+                yield x.jaxpr
+            elif hasattr(x, "eqns"):
+                yield x
+
+
+def find_subjaxprs(jaxpr, prim_name: str) -> List[object]:
+    """All sub-jaxprs carried by equations named ``prim_name``,
+    searching recursively but NOT descending into the matches
+    themselves (a while inside a while body is not re-reported)."""
+    jaxpr = unwrap(jaxpr)
+    found = []
+    for eqn in jaxpr.eqns:
+        subs = list(subvalues(eqn))
+        if eqn.primitive.name == prim_name:
+            found += subs
+        else:
+            for sub in subs:
+                found += find_subjaxprs(sub, prim_name)
+    return found
+
+
+def count_prims(jaxpr, names: Sequence[str]) -> int:
+    """Recursive census: equations named in ``names`` at every depth."""
+    jaxpr = unwrap(jaxpr)
+    n = sum(1 for eqn in jaxpr.eqns if eqn.primitive.name in names)
+    for eqn in jaxpr.eqns:
+        for sub in subvalues(eqn):
+            n += count_prims(sub, names)
+    return n
+
+
+def count_eqns(jaxpr) -> int:
+    """Total equation count at every depth — the op-budget metric."""
+    jaxpr = unwrap(jaxpr)
+    n = len(jaxpr.eqns)
+    for eqn in jaxpr.eqns:
+        for sub in subvalues(eqn):
+            n += count_eqns(sub)
+    return n
+
+
+def top_counts(jaxpr, names: Iterable[str]) -> Dict[str, int]:
+    """Per-name census of the TOP LEVEL only — pins structure like
+    "exactly one reduce_min and one cond at the loop-body top level"."""
+    jaxpr = unwrap(jaxpr)
+    return {
+        n: sum(1 for e in jaxpr.eqns if e.primitive.name == n)
+        for n in names
+    }
+
+
+def prim_paths(jaxpr, names: Sequence[str], limit: int = 6,
+               _prefix: str = "") -> List[str]:
+    """Human-readable paths to the first ``limit`` occurrences of the
+    named primitives — the "path into the jaxpr" half of a drift diff,
+    e.g. ``eqns[3]:while > eqns[17]:ppermute``."""
+    jaxpr = unwrap(jaxpr)
+    out: List[str] = []
+    for i, eqn in enumerate(jaxpr.eqns):
+        here = f"{_prefix}eqns[{i}]:{eqn.primitive.name}"
+        if eqn.primitive.name in names:
+            out.append(here)
+            if len(out) >= limit:
+                return out
+        for sub in subvalues(eqn):
+            out += prim_paths(sub, names, limit - len(out), here + " > ")
+            if len(out) >= limit:
+                return out
+    return out
+
+
+def largest_body(jaxpr, prim_name: str = "while"):
+    """The biggest sub-jaxpr under equations named ``prim_name`` — a
+    while carries [cond, body]; the body is the big one."""
+    subs = find_subjaxprs(jaxpr, prim_name)
+    if not subs:
+        return None
+    return max(subs, key=lambda j: len(unwrap(j).eqns))
+
+
+def collective_counts(bodies: Sequence[object]) -> Dict[str, int]:
+    """Collective census keyed like ``exchange.plan_collectives``:
+    ppermute / all_to_all exactly as planned, psum folded over its
+    lowering aliases, pmax for telemetry, gather == the banned
+    family."""
+    return {
+        "ppermute": sum(count_prims(b, ("ppermute",)) for b in bodies),
+        "all_to_all": sum(
+            count_prims(b, ("all_to_all",)) for b in bodies
+        ),
+        "psum": sum(count_prims(b, PSUM_PRIMS) for b in bodies),
+        "pmax": sum(count_prims(b, ("pmax",)) for b in bodies),
+        "gather": sum(count_prims(b, GATHER_PRIMS) for b in bodies),
+    }
+
+
+def narrow_outvars(jaxpr) -> int:
+    """How many of a jaxpr's outputs stay on the narrow packed planes
+    (uint8/uint16) — the dtype rule: packed state must leave the cycle
+    as narrow as it entered (widening is transient, inside `_widen*`)."""
+    jaxpr = unwrap(jaxpr)
+    n = 0
+    for v in jaxpr.outvars:
+        dt = getattr(getattr(v, "aval", None), "dtype", None)
+        if dt is not None and str(dt) in ("uint8", "uint16"):
+            n += 1
+    return n
+
+
+# -- compiled-HLO layer -----------------------------------------------
+
+HLO_COLLECTIVES = (
+    "all-reduce(", "all-gather(", "collective-permute(",
+    "all-to-all(", "reduce-scatter(",
+)
+
+_HLO_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s+\(")
+_HLO_LOOP_ROOT_RE = re.compile(r"(?:condition|body)=%?([\w.\-]+)")
+_HLO_REF_RE = re.compile(r"%([\w.\-]+)")
+
+
+def hlo_computations(text: str) -> Dict[str, List[str]]:
+    """Split compiled-HLO text into {computation name: body lines}."""
+    comps: Dict[str, List[str]] = {}
+    name = None
+    for line in text.splitlines():
+        m = _HLO_COMP_RE.match(line)
+        if m and line.rstrip().endswith("{"):
+            name = m.group(1)
+            comps[name] = []
+        elif name is not None:
+            comps[name].append(line)
+    return comps
+
+
+def hlo_loop_closure(comps: Dict[str, List[str]], text: str):
+    """Every computation reachable from a while condition/body — the
+    SPMD partitioner inlines the cycle loop here, so an op in this
+    closure runs once per cycle (or per call), not once per run."""
+    seen = set(_HLO_LOOP_ROOT_RE.findall(text)) & set(comps)
+    todo = list(seen)
+    while todo:
+        for line in comps[todo.pop()]:
+            for ref in _HLO_REF_RE.findall(line):
+                if ref in comps and ref not in seen:
+                    seen.add(ref)
+                    todo.append(ref)
+    return seen
+
+
+def hlo_loop_collectives(text: str) -> List[Tuple[str, str]]:
+    """(computation, line) for every collective inside the transitive
+    closure of the compiled while loops.  The final status reduce
+    compiles to an all-reduce in ENTRY — outside every loop — which
+    this probe deliberately permits."""
+    comps = hlo_computations(text)
+    closure = hlo_loop_closure(comps, text)
+    return [
+        (name, line.strip())
+        for name in sorted(closure)
+        for line in comps[name]
+        if any(c in line for c in HLO_COLLECTIVES)
+    ]
+
+
+def hlo_aliased_outputs(text: str) -> int:
+    """Donation/aliasing probe: the number of input→output aliases the
+    compiler committed to (``input_output_alias={...}`` in the module
+    header).  Zero means every donated buffer was silently copied."""
+    m = re.search(r"input_output_alias=\{([^}]*(?:\}[^}]*)*?)\}\s*[,)]",
+                  text)
+    if m is None:
+        m = re.search(r"input_output_alias=\{(.*)$", text, re.MULTILINE)
+        if m is None:
+            return 0
+    return len(re.findall(r"\(\s*\d+\s*,", m.group(1)))
+
+
+# -- jit-cache layer --------------------------------------------------
+
+def cache_size(fn) -> int:
+    """Compiled-entry count of a jitted callable, via the same
+    ``_cache_size`` probe the serving sessions' zero-recompile guards
+    use; -1 if the callable exposes no cache probe."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return -1
+    return int(probe())

@@ -1,6 +1,6 @@
 """AST lint for JAX pitfalls and dead spec handlers.
 
-Five rules, all tuned to be zero-finding on clean engine code:
+Eight rules, all tuned to be zero-finding on clean engine code:
 
 * **traced-branch** — a Python ``if``/``while``/``assert``/ternary in a
   JAX op module whose test reads a value derived from a ``SimState``
@@ -41,6 +41,17 @@ Five rules, all tuned to be zero-finding on clean engine code:
   a *seeded* RNG, the topology model keeps **none** (its spec/JAX
   agreement proof depends on it), so in this package a seeded
   ``random.Random`` is banned too.
+* **hand-written-state** — the device step and the Pallas kernel
+  (``ops/step.py``, ``ops/pallas_engine.py``) may not import or spell
+  ``CacheState``/``DirState`` enum constants; every protocol state
+  must resolve through the compiled ``ProtocolPlanes`` so the
+  TransitionTable stays the single source of truth.
+* **counter-backfill** — every only-when-nonzero stats counter read
+  from a ``SimState`` field in ``ops/engine.py::engine_stats`` must be
+  zero-backfilled by the checkpoint loader's ``_ZERO_BACKFILL`` set
+  (``utils/checkpoint.py``).  A counter field added without the
+  backfill makes every pre-existing checkpoint unloadable — PRs 15
+  and 16 both had to hand-patch exactly this.
 
 CLI: ``python -m hpa2_tpu.analysis lint`` (a tier-1 test runs it).
 """
@@ -582,6 +593,102 @@ def lint_file(repo_root: str, rel: str) -> List[LintFinding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# counter-backfill (cross-file: ops/engine.py stats vs utils/checkpoint.py)
+# ---------------------------------------------------------------------------
+
+_STATS_FILE = os.path.join("hpa2_tpu", "ops", "engine.py")
+_CHECKPOINT_FILE = os.path.join("hpa2_tpu", "utils", "checkpoint.py")
+
+
+def _is_st_attr(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "st")
+
+
+def _stats_optional_fields(tree: ast.Module):
+    """SimState fields feeding only-when-nonzero keys in
+    ``engine_stats``: every ``st.<field>`` the function reads OUTSIDE
+    the always-present ``core = {...}`` literal (``msg_counts`` is an
+    original schema-v1 plane, exempt).  Returns {field: lineno} or
+    None when the function is missing."""
+    fn = next((n for n in ast.walk(tree)
+               if isinstance(n, ast.FunctionDef)
+               and n.name == "engine_stats"), None)
+    if fn is None:
+        return None
+    always: Set[str] = {"msg_counts"}
+    for sub in ast.walk(fn):
+        if (isinstance(sub, ast.Assign)
+                and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Name)
+                and sub.targets[0].id == "core"
+                and isinstance(sub.value, ast.Dict)):
+            for v in ast.walk(sub.value):
+                if _is_st_attr(v):
+                    always.add(v.attr)
+    fields = {}
+    for sub in ast.walk(fn):
+        if _is_st_attr(sub) and sub.attr not in always:
+            fields.setdefault(sub.attr, sub.lineno)
+    return fields
+
+
+def _checkpoint_backfill(tree: ast.Module) -> Optional[Set[str]]:
+    """The names in checkpoint.py's ``_ZERO_BACKFILL`` frozenset
+    literal, or None when the assignment is missing."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_ZERO_BACKFILL"):
+            return {
+                c.value for c in ast.walk(node.value)
+                if isinstance(c, ast.Constant)
+                and isinstance(c.value, str)
+            }
+    return None
+
+
+def lint_counter_backfill(repo_root: str) -> List[LintFinding]:
+    """Cross-file rule: only-when-nonzero stats counters must be
+    checkpoint-backfilled.  Zero findings when either file is absent
+    (synthetic lint-test roots carry only the files they probe)."""
+    paths = {}
+    for rel in (_STATS_FILE, _CHECKPOINT_FILE):
+        full = os.path.join(repo_root, rel)
+        if not os.path.isfile(full):
+            return []
+        with open(full, "r") as f:
+            try:
+                paths[rel] = ast.parse(f.read(), filename=rel)
+            except SyntaxError:
+                return []  # the per-file pass reports the parse error
+    fields = _stats_optional_fields(paths[_STATS_FILE])
+    if fields is None:
+        return [LintFinding(
+            "counter-backfill", _STATS_FILE, 0,
+            "engine_stats() not found — the counter-backfill rule "
+            "needs updating for the new stats entry point")]
+    backfill = _checkpoint_backfill(paths[_CHECKPOINT_FILE])
+    if backfill is None:
+        return [LintFinding(
+            "counter-backfill", _CHECKPOINT_FILE, 0,
+            "_ZERO_BACKFILL frozenset not found — the checkpoint "
+            "loader lost its telemetry-counter backfill")]
+    return [
+        LintFinding(
+            "counter-backfill", _STATS_FILE, lineno,
+            f"optional stats counter reads st.{field} but "
+            f"utils/checkpoint.py::_ZERO_BACKFILL does not backfill "
+            f"{field!r} — checkpoints written before the counter "
+            f"existed become unloadable")
+        for field, lineno in sorted(fields.items())
+        if field not in backfill
+    ]
+
+
 def default_targets(repo_root: str) -> List[str]:
     out: List[str] = []
     for d in ENGINE_DIRS:
@@ -601,5 +708,6 @@ def run_lint(repo_root: str, targets: Optional[Iterable[str]] = None
     findings: List[LintFinding] = []
     for rel in rels:
         findings.extend(lint_file(repo_root, rel))
+    findings.extend(lint_counter_backfill(repo_root))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

@@ -49,9 +49,23 @@ _META_VERSION = 2
 #: schema v2; absent (= zero) in every older checkpoint.
 RECOVERY_COUNTERS = ("migrations", "evacuations", "shed_jobs", "retries")
 
-# Replicated telemetry counters that may be absent from checkpoints
-# written before they existed; zero-backfilled on load.
+# Telemetry counters that may be absent from checkpoints written
+# before they existed; zero-backfilled on load (all share n_msgs's
+# shape — scalar, or [b] in batched states).  Every only-when-nonzero
+# counter engine_stats() reads must appear here: the counter-backfill
+# lint rule (analysis/lint.py) checks this file against ops/engine.py
+# so the PR-15/PR-16 hand-patching never recurs.
 _ZERO_BACKFILL = frozenset({
+    # fault layer (ISSUE-9)
+    "n_retrans", "n_dup_filtered", "n_reorder_fixed", "n_delays",
+    "n_wire_stalls",
+    # interconnect topology (ISSUE-11)
+    "n_topo_delay", "n_multicast_saved", "n_combined",
+    # cycle elision (ISSUE-12)
+    "n_elided", "n_multi_hit",
+    # protocol variants (ISSUE-13)
+    "n_forwards", "n_owner_xfer", "n_dir_overflow",
+    # cross-shard exchange (ISSUE-15)
     "n_exch_sent", "n_exch_hwm", "n_exch_mc_saved", "n_exch_combined",
 })
 

@@ -1,13 +1,15 @@
 #!/usr/bin/env bash
 # Static analysis gate: declarative-table checks + spec equivalence,
 # the JAX-pitfall/dead-handler lint, the analyzer's mutation self-test,
-# and the ASan+UBSan smoke run of the native backend.
+# the compiled-program contract check (jaxpr/HLO pins over every
+# engine path), and the ASan+UBSan smoke run of the native backend.
 #
 # The same checks also run inside tier-1 (tests/test_analysis.py,
-# tests/test_table_equivalence.py, tests/test_sanitizers.py); this
-# script is the fast standalone entry point — no JAX import, a few
-# seconds end to end.  Cross-backend equivalence including the JAX and
-# native engines: python -m hpa2_tpu.analysis equiv
+# tests/test_table_equivalence.py, tests/test_sanitizers.py,
+# tests/test_contracts.py); this script is the standalone entry point.
+# Only the contracts section imports JAX — everything before it is
+# AST/table work, a few seconds end to end.  Cross-backend equivalence
+# including the JAX and native engines: python -m hpa2_tpu.analysis equiv
 set -e
 cd "$(dirname "$0")/.."
 
@@ -19,6 +21,12 @@ python -m hpa2_tpu.analysis lint
 
 echo "== analyzer mutation self-test =="
 python -m hpa2_tpu.analysis mutation-test
+
+echo "== compiled-program contracts (jaxpr/HLO pins, all engines) =="
+# the one section that imports JAX: traces every engine path on the
+# virtual 8-device CPU mesh and diffs against the checked-in pins
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m hpa2_tpu.analysis contracts --check
 
 echo "== native ASan+UBSan smoke =="
 if make -C native asan >/dev/null 2>&1; then

@@ -356,6 +356,18 @@ then
   echo "TIER1: chaos smoke failed" >&2
   exit 1
 fi
+# Contracts smoke (~3min, virtual mesh): the ISSUE-17 compiled-program
+# contract engine — every registered jaxpr/HLO contract point (XLA run
+# loop, Pallas cycle body, serving sessions, recovery-resume, node-
+# and data-sharded programs) must match its checked-in pins.  A drift
+# here means a structural change to a traced program that no
+# behavioral test may notice (an extra collective, a grown hot loop, a
+# lost donation) — fail with the drift diff before the pytest budget.
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m hpa2_tpu.analysis contracts --check; then
+  echo "TIER1: compiled-program contracts drifted" >&2
+  exit 1
+fi
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log

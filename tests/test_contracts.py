@@ -1,0 +1,222 @@
+"""Tier-1 coverage for the compiled-program contract engine
+(hpa2_tpu/analysis/contracts.py + analysis/ir.py).
+
+Three planes:
+
+* the checked-in registry still carries the exact historical pins the
+  old ad-hoc test walkers enforced (no guard weakened by the
+  migration),
+* the pin files on disk are present and digest-fresh for every
+  contract with pinned rules,
+* a seeded op-module mutation makes the check FAIL with a structural
+  drift diff that names the offending primitive — the negative test
+  that proves the engine can actually catch a regression.
+
+Everything here runs on plain CPU (num_procs=4 programs, no mesh);
+the device-hungry contract points are exercised by `analysis
+contracts --check` under the virtual mesh in run_static.sh.
+"""
+
+import pytest
+
+from hpa2_tpu.analysis import contracts
+from hpa2_tpu.analysis.contracts import (
+    check_contract,
+    load_pins,
+    registry,
+    seeded_mutation,
+    spec_digest,
+)
+
+
+def _by_name(name):
+    c = next((c for c in registry() if c.name == name), None)
+    assert c is not None, f"contract {name!r} missing from registry"
+    return c
+
+
+def _rules(c):
+    return {r.key: (r.op, r.expect) for r in c.rules}
+
+
+# -- registry shape ---------------------------------------------------
+
+
+def test_registry_covers_required_engine_paths():
+    cs = registry()
+    assert len(cs) >= 8
+    names = {c.name for c in cs}
+    # one serving-session and one recovery-resume program, per the
+    # coverage floor
+    assert "pallas-serving-session" in names
+    assert "serving-recovery-resume" in names
+    assert {c.engine for c in cs} >= {"xla", "pallas", "serving",
+                                      "sharded"}
+    assert len(names) == len(cs), "duplicate contract names"
+
+
+# -- the migrated historical pins, verbatim ---------------------------
+
+
+def test_run_loop_contract_carries_elision_pins():
+    rules = _rules(_by_name("xla-run-loop"))
+    assert rules["elided.reduce_min"] == ("==", 1)
+    assert rules["elided.cond"] == ("==", 1)
+    for k in ("elided.while", "elided.scan", "elided.dot_general",
+              "elided.sort"):
+        assert rules[k] == ("==", 0), k
+    assert rules["lockstep.cond"] == ("==", 0)
+    assert rules["lockstep.extra_eqns"] == (">=", 1)
+
+
+def test_cycle_body_contract_carries_op_ceilings():
+    rules = _rules(_by_name("pallas-cycle-body"))
+    assert rules["eqns.plain"] == ("<=", 2172)
+    assert rules["eqns.snap"] == ("<=", 2194)
+    assert rules["collectives"] == ("==", 0)
+
+
+def test_node_sharded_contracts_carry_collective_pins():
+    a2a = _rules(_by_name("node-sharded-pallas-a2a"))
+    assert a2a["ppermute"] == ("==", 0)
+    assert a2a["all_to_all"] == ("==", 2)
+    assert a2a["psum"] == ("==", 2)
+    assert a2a["pmax"] == ("==", 3)
+    assert a2a["gather"] == ("==", 0)
+    jx = _rules(_by_name("node-sharded-jax-a2a"))
+    assert jx["all_to_all"] == ("==", 2)
+    assert jx["pmax"] == ("==", 1)
+    assert jx["gather"] == ("==", 0)
+    pw = _rules(_by_name("node-sharded-jax-pairwise"))
+    assert pw["ppermute"] == ("==", 6)
+    assert pw["all_to_all"] == ("==", 0)
+
+
+def test_dma_and_gather_guards_present():
+    dma = _rules(_by_name("pallas-stream-dma"))
+    assert dma["dma.in_while"] == ("==", 0)
+    assert dma["dma_start.total"] == (">=", 2)
+    assert _rules(_by_name("xla-run-interconnect"))["gather"] == \
+        ("==", 0)
+    assert _rules(_by_name("data-sharded-pallas"))[
+        "shard_body.collectives"] == ("==", 0)
+
+
+# -- pin files --------------------------------------------------------
+
+
+def test_pin_files_present_and_digest_fresh():
+    for c in registry():
+        pinned = [r.key for r in c.rules if r.expect is None]
+        if not pinned:
+            continue
+        doc = load_pins(c)
+        assert doc is not None, (
+            f"{c.name}: pinned rules but no pin file — run "
+            "`analysis contracts --repin`"
+        )
+        assert doc.get("digest") == spec_digest(c), (
+            f"{c.name}: rule spec changed since the pin file was "
+            "minted — run `analysis contracts --repin`"
+        )
+        missing = [k for k in pinned if k not in doc.get("pins", {})]
+        assert not missing, f"{c.name}: unpinned keys {missing}"
+
+
+# -- live measurement reproduces the pins (CPU-safe point) ------------
+
+
+def test_run_loop_measurement_is_drift_free():
+    c = _by_name("xla-run-loop")
+    drifts = check_contract(c, c.measure())
+    assert not drifts, "\n".join(d.render() for d in drifts)
+
+
+# -- seeded-mutation negative test ------------------------------------
+
+
+def test_seeded_mutation_fails_with_named_drift_diff():
+    """Perturb ops/step.py (force the lockstep escape hatch on) and
+    the xla-run-loop contract must fail, with a drift diff that names
+    the structural change — the reduce_min/cond shape of the elided
+    body."""
+    c = _by_name("xla-run-loop")
+    with seeded_mutation(1):
+        drifts = check_contract(c, c.measure())
+    assert drifts, "mutation went undetected — the contract is vacuous"
+    keys = {d.key for d in drifts}
+    assert keys & {"elided.reduce_min", "elided.cond"}, keys
+    diff = "\n".join(d.render() for d in drifts)
+    assert "expected" in diff and "found" in diff
+    # ...and the mutation context restores the real engine afterwards
+    assert not check_contract(c, c.measure())
+
+
+def test_seeded_mutation_even_seed_rewires_exchange_plan():
+    from hpa2_tpu.ops import exchange
+
+    with seeded_mutation(0):
+        plan = exchange.make_plan(4, "a2a", 0)
+        assert plan.mode == "pairwise"
+    assert exchange.make_plan(4, "a2a", 0).mode == "a2a"
+
+
+# -- counter-backfill lint rule (cross-file, negative + clean) --------
+
+
+def _write_stats_pair(root, backfill_names):
+    ops = root / "hpa2_tpu" / "ops"
+    utils = root / "hpa2_tpu" / "utils"
+    ops.mkdir(parents=True)
+    utils.mkdir(parents=True)
+    (ops / "engine.py").write_text(
+        "def engine_stats(st):\n"
+        "    core = {\"cycle\": st.cycle}\n"
+        "    out = dict(core)\n"
+        "    if st.n_shiny:\n"
+        "        out[\"n_shiny\"] = int(st.n_shiny)\n"
+        "    return out\n"
+    )
+    names = ", ".join(f"\"{n}\"" for n in backfill_names)
+    (utils / "checkpoint.py").write_text(
+        f"_ZERO_BACKFILL = frozenset({{{names}}})\n"
+    )
+
+
+def test_counter_backfill_lint_flags_unbackfilled_counter(tmp_path):
+    from hpa2_tpu.analysis.lint import lint_counter_backfill
+
+    _write_stats_pair(tmp_path, ["n_other"])
+    findings = lint_counter_backfill(str(tmp_path))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "counter-backfill"
+    assert "n_shiny" in f.message
+    assert "_ZERO_BACKFILL" in f.message
+
+
+def test_counter_backfill_lint_clean_when_backfilled(tmp_path):
+    from hpa2_tpu.analysis.lint import lint_counter_backfill
+
+    _write_stats_pair(tmp_path, ["n_shiny"])
+    assert lint_counter_backfill(str(tmp_path)) == []
+
+
+def test_counter_backfill_skips_roots_without_engine(tmp_path):
+    # synthetic lint-test roots carry only the files they probe
+    from hpa2_tpu.analysis.lint import lint_counter_backfill
+
+    assert lint_counter_backfill(str(tmp_path)) == []
+
+
+# -- drift rendering --------------------------------------------------
+
+
+def test_drift_render_carries_location_and_why():
+    d = contracts.Drift("c", "gather", "==", 0, 2,
+                        where="eqns[3]:while > eqns[7]:all_gather",
+                        why="gather-the-world ban")
+    out = d.render()
+    assert "gather: expected == 0, found 2" in out
+    assert "eqns[7]:all_gather" in out
+    assert "gather-the-world ban" in out

@@ -13,8 +13,6 @@ Runs on the virtual 8-device CPU mesh from conftest; skipped cleanly
 when the device-count flag could not take effect.
 """
 
-import re
-
 import jax
 import numpy as np
 import pytest
@@ -169,42 +167,10 @@ def test_make_data_mesh_bounds():
 
 
 # -- collective-free hot loop (jaxpr layer) ---------------------------
-
-
-def _subvalues(eqn):
-    for v in eqn.params.values():
-        vs = v if isinstance(v, (list, tuple)) else (v,)
-        for x in vs:
-            if hasattr(x, "jaxpr"):
-                yield x.jaxpr
-            elif hasattr(x, "eqns"):
-                yield x
-
-
-def _find_subjaxprs(jaxpr, prim_name):
-    found = []
-    for eqn in jaxpr.eqns:
-        subs = list(_subvalues(eqn))
-        if eqn.primitive.name == prim_name:
-            found += subs
-        else:
-            for sub in subs:
-                found += _find_subjaxprs(sub, prim_name)
-    return found
-
-
-def _count_prims(jaxpr, names):
-    n = sum(1 for eqn in jaxpr.eqns if eqn.primitive.name in names)
-    for eqn in jaxpr.eqns:
-        for sub in _subvalues(eqn):
-            n += _count_prims(sub, names)
-    return n
-
-
-_COLLECTIVE_PRIMS = (
-    "psum", "psum2", "pmax", "pmin", "ppermute", "pbroadcast",
-    "all_gather", "all_to_all", "reduce_scatter",
-)
+#
+# The traversal and primitive lists live in hpa2_tpu/analysis/ir.py
+# (one walker for the whole repo); the same properties are enforced by
+# the checked-in `data-sharded-pallas` contract.
 
 
 @pytest.mark.parametrize("stream", [True, False],
@@ -214,6 +180,8 @@ def test_shard_body_has_no_collectives(stream):
     collective-free: each shard's whole run — block grid, prefetch,
     quiescence loop — is independent.  The status reduce lives outside
     the shard_map."""
+    from hpa2_tpu.analysis import ir
+
     _require_devices(8)
     cfg = SystemConfig(num_procs=4, semantics=ROBUST)
     arrays = gen_uniform_random_arrays(cfg, 32, 8, seed=1)
@@ -223,53 +191,19 @@ def test_shard_body_has_no_collectives(stream):
     jx = jax.make_jaxpr(eng._runner(10_000))(
         eng.state, eng._tr_full, eng._tr_len_full
     )
-    bodies = _find_subjaxprs(jx.jaxpr, "shard_map")
+    bodies = ir.find_subjaxprs(jx.jaxpr, "shard_map")
     assert bodies, "sharded runner lost its shard_map"
     assert any(
-        _count_prims(b, ("pallas_call",)) for b in bodies
+        ir.count_prims(b, ("pallas_call",)) for b in bodies
     ), "shard body lost its pallas_call"
     for body in bodies:
-        n = _count_prims(body, _COLLECTIVE_PRIMS)
+        n = ir.count_prims(body, ir.COLLECTIVE_PRIMS)
         assert n == 0, (
             f"{n} collective op(s) inside the per-shard run program"
         )
 
 
 # -- collective-free cycle body (compiled-HLO layer) ------------------
-
-_HLO_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s+\(")
-_HLO_LOOP_ROOT_RE = re.compile(r"(?:condition|body)=%?([\w.\-]+)")
-_HLO_COLLECTIVES = (
-    "all-reduce(", "all-gather(", "collective-permute(",
-    "all-to-all(", "reduce-scatter(",
-)
-
-
-def _hlo_computations(text):
-    comps, name = {}, None
-    for line in text.splitlines():
-        m = _HLO_COMP_RE.match(line)
-        if m and line.rstrip().endswith("{"):
-            name = m.group(1)
-            comps[name] = []
-        elif name is not None:
-            comps[name].append(line)
-    return comps
-
-
-def _loop_closure(comps, text):
-    """Every computation reachable from a while condition/body — the
-    SPMD partitioner inlines the cycle loop here, so a collective in
-    this closure runs once per cycle (or per call), not once per run."""
-    seen = set(_HLO_LOOP_ROOT_RE.findall(text)) & set(comps)
-    todo = list(seen)
-    while todo:
-        for line in comps[todo.pop()]:
-            for ref in re.findall(r"%([\w.\-]+)", line):
-                if ref in comps and ref not in seen:
-                    seen.add(ref)
-                    todo.append(ref)
-    return seen
 
 
 def test_compiled_hlo_loop_body_collective_free():
@@ -279,22 +213,20 @@ def test_compiled_hlo_loop_body_collective_free():
     of the compiled while loops.  (The final status reduce compiles to
     an all-reduce in ENTRY — outside every loop — which this guard
     deliberately permits.)"""
+    from hpa2_tpu.analysis import ir
+
     _require_devices(8)
     cfg = SystemConfig(num_procs=4, semantics=ROBUST)
     arrays = gen_uniform_random_arrays(cfg, 32, 8, seed=1)
     eng = DataShardedPallasEngine(cfg, *arrays, data_shards=8, block=4)
     text = eng.lower_run(10_000).compile().as_text()
 
-    comps = _hlo_computations(text)
-    closure = _loop_closure(comps, text)
-    assert closure, "compiled module has no while loops to guard"
+    comps = ir.hlo_computations(text)
+    assert ir.hlo_loop_closure(comps, text), (
+        "compiled module has no while loops to guard"
+    )
 
-    offenders = [
-        (name, line.strip())
-        for name in closure
-        for line in comps[name]
-        if any(c in line for c in _HLO_COLLECTIVES)
-    ]
+    offenders = ir.hlo_loop_collectives(text)
     assert not offenders, (
         "collective(s) inside the compiled cycle loop:\n"
         + "\n".join(f"  {n}: {ln}" for n, ln in offenders[:8])

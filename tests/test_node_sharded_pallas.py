@@ -24,7 +24,6 @@ import pytest
 
 from hpa2_tpu.config import Semantics, SystemConfig
 from hpa2_tpu.models.spec_engine import StallError
-from hpa2_tpu.ops import exchange
 from hpa2_tpu.ops.engine import JaxEngine
 from hpa2_tpu.ops.pallas_engine import PallasEngine
 from hpa2_tpu.ops.schedule import Schedule
@@ -264,79 +263,22 @@ def test_geometry_validation():
 # shows up as all_gather > 0 or a changed collective count.
 
 
-def _subvalues(eqn):
-    for v in eqn.params.values():
-        vs = v if isinstance(v, (list, tuple)) else (v,)
-        for x in vs:
-            if hasattr(x, "jaxpr"):
-                yield x.jaxpr
-            elif hasattr(x, "eqns"):
-                yield x
-
-
-def _find_subjaxprs(jaxpr, prim_name):
-    found = []
-    for eqn in jaxpr.eqns:
-        subs = list(_subvalues(eqn))
-        if eqn.primitive.name == prim_name:
-            found += subs
-        else:
-            for sub in subs:
-                found += _find_subjaxprs(sub, prim_name)
-    return found
-
-
-def _count_prims(jaxpr, names):
-    n = sum(1 for eqn in jaxpr.eqns if eqn.primitive.name in names)
-    for eqn in jaxpr.eqns:
-        for sub in _subvalues(eqn):
-            n += _count_prims(sub, names)
-    return n
-
-
-_PSUM_PRIMS = ("psum", "psum2", "psum_invariant")
-# NOTE: all_to_all is a *legitimate* exchange collective since the
-# batched-transport rework — only the gather-the-world family is banned
-_GATHER_PRIMS = ("all_gather", "all_gather_invariant")
 _MODES = ("pairwise", "a2a", "butterfly", "hier")
-
-
-def _collective_counts(bodies):
-    return {
-        "ppermute": sum(_count_prims(b, ("ppermute",)) for b in bodies),
-        "all_to_all": sum(
-            _count_prims(b, ("all_to_all",)) for b in bodies
-        ),
-        "psum": sum(_count_prims(b, _PSUM_PRIMS) for b in bodies),
-        "pmax": sum(_count_prims(b, ("pmax",)) for b in bodies),
-        "gather": sum(_count_prims(b, _GATHER_PRIMS) for b in bodies),
-    }
 
 
 @pytest.mark.parametrize("mode", _MODES)
 @pytest.mark.parametrize("node_shards", [2, 4])
 def test_cycle_loop_collectives_pinned(node_shards, mode):
+    from hpa2_tpu.analysis.contracts import measure_node_sharded
+
     _require_devices(node_shards)
-    cfg = dataclasses.replace(_cfg(), exchange_mode=mode)
-    eng = NodeShardedPallasEngine(
-        cfg, *_arrays(), node_shards=node_shards,
-        cycles_per_call=16,
-    )
-    jx = jax.make_jaxpr(eng._runner(10_000))(
-        eng.state, eng._tr_full, eng._tr_len_full
-    ).jaxpr
-    bodies = _find_subjaxprs(jx, "shard_map")
-    assert bodies, "node-sharded runner lost its shard_map"
-    got = _collective_counts(bodies)
-    plan = exchange.plan_collectives(
-        exchange.make_plan(node_shards, mode, 0)
-    )
-    assert got["ppermute"] == plan["ppermute"], (
-        f"{mode}@{node_shards}: plan ships {plan['ppermute']} "
+    got = measure_node_sharded("pallas", mode, node_shards).values
+    assert got["ppermute"] == got["plan.ppermute"], (
+        f"{mode}@{node_shards}: plan ships {got['plan.ppermute']} "
         f"ppermutes, traced {got['ppermute']}"
     )
-    assert got["all_to_all"] == plan["all_to_all"], (
-        f"{mode}@{node_shards}: plan ships {plan['all_to_all']} "
+    assert got["all_to_all"] == got["plan.all_to_all"], (
+        f"{mode}@{node_shards}: plan ships {got['plan.all_to_all']} "
         f"all_to_alls, traced {got['all_to_all']}"
     )
     # one stacked counter/quiescence psum in the cycle + the per-
@@ -439,19 +381,12 @@ def test_jax_step_collectives_pinned(mode):
     carries exactly the plan's collectives + 1 stacked counter psum
     (+ the elision fast-forward's progress psum — elide defaults on)
     + 1 telemetry pmax, no all_gather."""
+    from hpa2_tpu.analysis.contracts import measure_node_sharded
+
     _require_devices(4)
-    cfg = dataclasses.replace(_cfg(), exchange_mode=mode)
-    traces = gen_uniform_random(cfg, 12, seed=7)
-    eng = NodeShardedEngine(
-        cfg, traces, mesh=make_mesh(node_shards=4)
-    )
-    jx = jax.make_jaxpr(eng._run)(eng.state).jaxpr
-    bodies = _find_subjaxprs(jx, "shard_map")
-    assert bodies, "node-sharded jax run lost its shard_map"
-    got = _collective_counts(bodies)
-    plan = exchange.plan_collectives(exchange.make_plan(4, mode, 0))
-    assert got["ppermute"] == plan["ppermute"]
-    assert got["all_to_all"] == plan["all_to_all"]
+    got = measure_node_sharded("jax", mode, 4).values
+    assert got["ppermute"] == got["plan.ppermute"]
+    assert got["all_to_all"] == got["plan.all_to_all"]
     assert got["psum"] == 2
     assert got["pmax"] == 1
     assert got["gather"] == 0

@@ -338,9 +338,10 @@ def test_fused_single_device_program_jaxpr_guard(cfg, small_zipf):
     import jax
     import jax.numpy as jnp
 
+    from hpa2_tpu.analysis.ir import (
+        count_eqns as _count_eqns, find_subjaxprs as _find_subjaxprs)
     from hpa2_tpu.ops import pallas_engine as pe
     from hpa2_tpu.ops.schedule import build_plan
-    from test_vmem_budget import _count_eqns, _find_subjaxprs
 
     arrays, _ = small_zipf
     eng = PallasEngine(

@@ -5,16 +5,12 @@ consistency, and the streaming kernel's structural invariants — the
 per-cycle hot loop gains no ops and no DMA from streaming (copies live
 at window boundaries only)."""
 
-import jax
-import jax.numpy as jnp
 import pytest
 
 from hpa2_tpu.analysis.vmem import (
     VMEM_CAP_BYTES, budget_table, vmem_budget)
 from hpa2_tpu.config import Semantics, SystemConfig
-from hpa2_tpu.ops.pallas_engine import (
-    PallasEngine, _init_state, build_cycle)
-from hpa2_tpu.utils.trace import gen_uniform_random_arrays
+from hpa2_tpu.ops.pallas_engine import _init_state
 
 
 def _bench_config():
@@ -170,79 +166,37 @@ class TestNodeSharding:
 
 
 class TestHotLoopGuards:
-    def _cycle_ops(self, snapshots):
-        cfg = _bench_config()
-        bb = 8
-        st = {k: jnp.asarray(v)
-              for k, v in _init_state(cfg, bb, snapshots).items()}
-        st["tr"] = jnp.zeros((8, 8, bb), jnp.int32)
-        st["tr_len"] = jnp.zeros((8, bb), jnp.int32)
-        jx = jax.make_jaxpr(build_cycle(cfg, bb, snapshots))(st)
-        return _count_eqns(jx.jaxpr)
+    """Structural hot-loop pins, measured through the contract engine
+    (analysis/contracts.py) — the single jaxpr traversal lives in
+    analysis/ir.py and the same ceilings are enforced by the checked-in
+    `pallas-cycle-body` / `pallas-stream-dma` contracts."""
 
     @pytest.mark.parametrize("snapshots", [False, True])
     def test_cycle_opcount_no_increase(self, snapshots):
-        ops = self._cycle_ops(snapshots)
+        from hpa2_tpu.analysis.contracts import (
+            measure_cycle_ops, registry)
+
+        key = "eqns.snap" if snapshots else "eqns.plain"
+        ops = measure_cycle_ops().values[key]
         assert ops <= _CYCLE_OPS_BASELINE[snapshots], (
             f"per-cycle op count grew: {ops} > "
             f"{_CYCLE_OPS_BASELINE[snapshots]} — the hot loop must not "
             "pay for streaming (or anything else) per cycle"
         )
+        # the declarative contract carries the identical ceiling
+        contract = next(
+            c for c in registry() if c.name == "pallas-cycle-body")
+        rules = {r.key: (r.op, r.expect) for r in contract.rules}
+        assert rules[key] == ("<=", _CYCLE_OPS_BASELINE[snapshots])
 
     def test_streaming_dma_outside_quiescence_loop(self):
         # copies live at window boundaries only: the while-to-
         # quiescence loop's jaxpr must contain no DMA primitives,
         # while the kernel overall must stream (>=1 dma_start)
-        cfg = _bench_config()
-        arrays = gen_uniform_random_arrays(cfg, 8, 16, seed=1)
-        eng = PallasEngine(cfg, *arrays, interpret=True, stream=True,
-                           snapshots=False, trace_window=8,
-                           gate=False, block=8)
-        jx = jax.make_jaxpr(eng._runner(10_000))(
-            eng.state, eng._tr_full, eng._tr_len_full)
-        kernels = _find_subjaxprs(jx.jaxpr, "pallas_call")
-        assert kernels, "streaming runner lost its pallas_call"
-        total_dma = sum(
-            _count_prims(k, ("dma_start",)) for k in kernels)
-        assert total_dma >= 2, "expected warm-up + prefetch dma_start"
-        for kernel in kernels:
-            for wh in _find_subjaxprs(kernel, "while"):
-                assert _count_prims(wh, ("dma_start", "dma_wait")) == 0
+        from hpa2_tpu.analysis.contracts import measure_stream_dma
 
-
-def _subvalues(eqn):
-    for v in eqn.params.values():
-        vs = v if isinstance(v, (list, tuple)) else (v,)
-        for x in vs:
-            if hasattr(x, "jaxpr"):
-                yield x.jaxpr
-            elif hasattr(x, "eqns"):
-                yield x
-
-
-def _count_eqns(jaxpr):
-    n = len(jaxpr.eqns)
-    for eqn in jaxpr.eqns:
-        for sub in _subvalues(eqn):
-            n += _count_eqns(sub)
-    return n
-
-
-def _find_subjaxprs(jaxpr, prim_name):
-    found = []
-    for eqn in jaxpr.eqns:
-        subs = list(_subvalues(eqn))
-        if eqn.primitive.name == prim_name:
-            found += subs
-        else:
-            for sub in subs:
-                found += _find_subjaxprs(sub, prim_name)
-    return found
-
-
-def _count_prims(jaxpr, names):
-    n = sum(1 for eqn in jaxpr.eqns if eqn.primitive.name in names)
-    for eqn in jaxpr.eqns:
-        for sub in _subvalues(eqn):
-            n += _count_prims(sub, names)
-    return n
+        got = measure_stream_dma().values
+        assert got["kernels"] >= 1, "streaming runner lost its pallas_call"
+        assert got["dma_start.total"] >= 2, (
+            "expected warm-up + prefetch dma_start")
+        assert got["dma.in_while"] == 0
